@@ -1,0 +1,16 @@
+"""Locust-TPU: a TPU-native distributed MapReduce framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capability surface of
+wuyan33/Locust (a CUDA + TCP MapReduce engine): fixed-width KV
+map -> shuffle -> reduce with device-side string processing, a staged CLI,
+and a multi-host distributed mode where the shuffle is an ICI all-to-all
+over a ``jax.sharding.Mesh`` and the final combine is a ``psum``.
+
+See SURVEY.md for the structural analysis of the reference this framework
+rebuilds, layer by layer.
+"""
+
+__version__ = "0.1.0"
+
+from locust_tpu.config import DEFAULT_CONFIG, DELIMITERS, EngineConfig  # noqa: F401
+from locust_tpu.core.kv import KVBatch  # noqa: F401

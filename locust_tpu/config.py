@@ -1,0 +1,79 @@
+"""Runtime configuration for the Locust-TPU engine.
+
+The reference (wuyan33/Locust) freezes its capacities at compile time via
+``#define``s — MAX_LINES_FILE_READ=5800, EMITS_PER_LINE=20, MAX_EMITS,
+GRID_SIZE/BLOCK_SIZE (reference MapReduce/src/main.cu:18-27).  On TPU, JIT
+specialization replaces compile-time constants, so the same knobs live in a
+runtime dataclass: each distinct config traces/compiles once and is cached.
+
+Byte-width caps mirror the reference's fixed-width KV structs
+(KeyValuePair.key[100]/value[100], KeyIntValuePair.key[30] —
+reference MapReduce/src/KeyValue.h:6-18), rounded up to TPU-friendly
+power-of-two widths (lane-sized multiples of 4 for uint32 key packing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+# Tokenization delimiter set — byte-for-byte the reference's strtok delimiters
+# (reference MapReduce/src/main.cu:138).  This *defines* WordCount semantics
+# (hyphens split words, apostrophes split contractions); see SURVEY.md Q11.
+DELIMITERS: bytes = b" ,.-;:'()\"\t"
+
+# Newline bytes also terminate tokens: the reference tokenizes line-by-line so
+# a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.
+PAD_BYTE: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape/capacity configuration of one MapReduce pipeline.
+
+    Frozen + hashable so it can be a ``jax.jit`` static argument.
+    """
+
+    # Max bytes per input line (value side). Reference: char value[100]
+    # (KeyValue.h:9) → rounded to 128 for TPU lane alignment.
+    line_width: int = 128
+
+    # Max bytes per emitted key. Reference: char key[30] (KeyValue.h:15) →
+    # rounded to 32 (8 uint32 big-endian lanes).
+    key_width: int = 32
+
+    # Max emits (tokens) per line. Reference: EMITS_PER_LINE=20 (main.cu:19).
+    emits_per_line: int = 20
+
+    # Lines per processing block. Reference caps the whole file at
+    # MAX_LINES_FILE_READ=5800 (main.cu:18); we instead stream fixed-size
+    # blocks so there is no global cap (SURVEY.md §5 "long-context").
+    block_lines: int = 4096
+
+    # Overflow behavior for > emits_per_line tokens: the reference prints
+    # "WARN: Exceeded emit limit" and drops (main.cu:141-144). We drop
+    # silently on device and surface a host-side overflow count.
+    warn_on_overflow: bool = True
+
+    # Use Pallas kernels for the map/reduce hot loops where available;
+    # otherwise pure-jnp/XLA lowering.
+    use_pallas: bool = False
+
+    def __post_init__(self):
+        if self.key_width <= 0 or self.key_width % 4 != 0:
+            raise ValueError("key_width must be a positive multiple of 4 (uint32 lanes)")
+        if self.line_width <= 0 or self.emits_per_line <= 0 or self.block_lines <= 0:
+            raise ValueError("line_width, emits_per_line, block_lines must be positive")
+
+    @property
+    def key_lanes(self) -> int:
+        """Number of uint32 big-endian lanes a packed key occupies."""
+        return self.key_width // 4
+
+    @property
+    def emits_per_block(self) -> int:
+        """Emit-table rows per block (analog of MAX_EMITS, main.cu:20)."""
+        return self.block_lines * self.emits_per_line
+
+
+DEFAULT_CONFIG = EngineConfig()

@@ -1,0 +1,2 @@
+from locust_tpu.core import bytes_ops, kv, packing  # noqa: F401
+from locust_tpu.core.kv import KVBatch  # noqa: F401

@@ -1,0 +1,141 @@
+"""Byte-tensor string primitives — the TPU-native device string library.
+
+The reference hand-rolls a device libc (my_strlen/my_strcmp/my_strcpy/
+my_strtok_r/my_reverse/my_itoa, reference MapReduce/src/util.cu:3-140) because
+CUDA kernels have no libc.  On TPU the idiomatic formulation is data-parallel
+ops over fixed-width ``uint8`` tensors: a "string" is a NUL-padded row, and
+every libc routine becomes a vectorized mask/scan/gather:
+
+  my_strlen   -> byte_length          (argmax of the NUL mask)
+  my_strcmp   -> packed-lane compare  (see core/packing.py; big-endian uint32
+                                       lane order == lexicographic byte order)
+  my_strcpy   -> array slicing / take_along_axis gathers
+  my_strtok_r -> token_starts/token_ids (delimiter mask + prefix-sum segment
+                 ids, replacing the inherently sequential strtok_r loop at
+                 util.cu:54-89 with one parallel pass)
+  my_itoa     -> itoa_bytes           (vectorized decimal digit extraction,
+                 replacing util.cu:106-140 + my_reverse at util.cu:91-104)
+
+All functions are shape-polymorphic over leading batch dims and jit-safe
+(static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from locust_tpu.config import DELIMITERS
+
+
+def byte_length(x: jax.Array) -> jax.Array:
+    """Length of each NUL-padded byte row: ``my_strlen`` (util.cu:3-9).
+
+    Args:
+      x: uint8 array ``[..., W]``, rows padded with 0 after the content.
+    Returns:
+      int32 array ``[...]`` — index of the first zero byte, or W if none.
+    """
+    w = x.shape[-1]
+    is_nul = x == 0
+    first = jnp.argmax(is_nul, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.any(is_nul, axis=-1), first, w)
+
+
+def delimiter_mask(x: jax.Array, delimiters: bytes = DELIMITERS) -> jax.Array:
+    """Boolean mask of bytes that terminate tokens.
+
+    Matches the reference's strtok delimiter set (main.cu:138) plus the NUL
+    pad byte and newline/carriage-return, which in the reference never reach
+    strtok because tokenization is per-getline-line.
+    """
+    delims = np.frombuffer(delimiters + b"\x00\n\r", dtype=np.uint8)
+    # Small membership test: [..., W, D] compare then any-reduce. D is ~13 so
+    # this stays cheap and fuses into one VPU pass.
+    return jnp.any(x[..., None] == jnp.asarray(delims), axis=-1)
+
+
+def token_starts(in_token: jax.Array) -> jax.Array:
+    """Mask of token first-bytes given an in-token (non-delimiter) mask.
+
+    A byte starts a token iff it is in-token and its left neighbor is not
+    (position 0 counts as having a delimiter neighbor) — the parallel
+    equivalent of strtok_r's "skip leading delimiters" phase (util.cu:63-70).
+    """
+    prev = jnp.pad(in_token[..., :-1], [(0, 0)] * (in_token.ndim - 1) + [(1, 0)])
+    return in_token & ~prev
+
+
+def token_ends(in_token: jax.Array) -> jax.Array:
+    """Mask of token last-bytes (right neighbor is a delimiter or row end)."""
+    nxt = jnp.pad(in_token[..., 1:], [(0, 0)] * (in_token.ndim - 1) + [(0, 1)])
+    return in_token & ~nxt
+
+
+def token_ids(starts: jax.Array) -> jax.Array:
+    """0-based token index at every byte position (valid where in-token).
+
+    ``cumsum(starts) - 1`` — the prefix-sum segment-id trick that replaces
+    the sequential token loop of strtok_r (util.cu:54-89).
+    """
+    return jnp.cumsum(starts.astype(jnp.int32), axis=-1) - 1
+
+
+def count_tokens(lines: jax.Array, delimiters: bytes = DELIMITERS) -> jax.Array:
+    """Number of tokens per row."""
+    starts = token_starts(~delimiter_mask(lines, delimiters))
+    return jnp.sum(starts.astype(jnp.int32), axis=-1)
+
+
+def itoa_bytes(values: jax.Array, width: int = 12) -> jax.Array:
+    """Non-negative int32 -> left-aligned ASCII decimal, NUL-padded.
+
+    Vectorized ``my_itoa`` (util.cu:106-140): digit extraction by repeated
+    division; the reference then reverses in place (my_reverse, util.cu:91-104)
+    — here we extract most-significant-first and left-shift by the digit
+    count instead, with a take_along_axis gather.
+
+    Args:
+      values: int32 ``[...]`` of non-negative integers (negatives clamp to 0).
+      width: output byte width; >= 10 so any int32 fits.
+    Returns:
+      uint8 ``[..., width]``.
+    """
+    if width < 10:
+        raise ValueError(f"width {width} cannot hold all int32 values (need >= 10)")
+    v = jnp.maximum(values.astype(jnp.int32), 0)
+    # Right-aligned digits, most significant first.  int32 holds <= 10 digits,
+    # so powers beyond 10^9 are materialized as 10^9 and masked to digit 0.
+    p_exp = list(range(width - 1, -1, -1))
+    pows = jnp.asarray([10 ** min(p, 9) for p in p_exp], dtype=jnp.int32)
+    in_range = jnp.asarray([p <= 9 for p in p_exp])
+    digits = jnp.where(in_range, (v[..., None] // pows) % 10, 0)  # [..., width]
+    ndig = jnp.maximum(
+        jnp.sum((in_range & (v[..., None] >= pows)).astype(jnp.int32), axis=-1), 1
+    )  # number of significant digits; v=0 -> 1
+    # Left-align: output position k reads right-aligned position k+(width-ndig).
+    k = jnp.arange(width, dtype=jnp.int32)
+    src = k + (width - ndig)[..., None]
+    gathered = jnp.take_along_axis(digits, jnp.clip(src, 0, width - 1), axis=-1)
+    ascii_digits = (gathered + ord("0")).astype(jnp.uint8)
+    return jnp.where(k < ndig[..., None], ascii_digits, jnp.uint8(0))
+
+
+def rows_to_strings(rows: np.ndarray) -> list[bytes]:
+    """Host-side: NUL-padded uint8 rows -> Python bytes (up to first NUL)."""
+    out = []
+    for row in np.asarray(rows):
+        b = row.tobytes()
+        i = b.find(b"\x00")
+        out.append(b if i < 0 else b[:i])
+    return out
+
+
+def strings_to_rows(strings: list[bytes], width: int) -> np.ndarray:
+    """Host-side: byte strings -> NUL-padded uint8 rows, truncated to width."""
+    out = np.zeros((len(strings), width), dtype=np.uint8)
+    for i, s in enumerate(strings):
+        s = s[:width]
+        out[i, : len(s)] = np.frombuffer(s, dtype=np.uint8)
+    return out

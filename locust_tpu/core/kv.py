@@ -1,0 +1,78 @@
+"""KV data model: fixed-width key/value batches as JAX pytrees.
+
+Replaces the reference's POD structs-of-char-arrays —
+``KeyValuePair{char key[100]; char value[100]; int ind}`` and
+``KeyIntValuePair{char key[30]; int value; int count}``
+(reference MapReduce/src/KeyValue.h:6-18) — with structure-of-arrays
+tensors: keys live as packed big-endian uint32 lanes (see core/packing.py),
+values as int32, and validity as an explicit bool mask instead of the
+empty-string sentinel that the reference's compaction predicates test
+(KeyIntValueNotEmpty, KeyValue.h:79-84).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from locust_tpu.core import bytes_ops, packing
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVBatch:
+    """A batch of (key, value) emits.
+
+    Attributes:
+      key_lanes: uint32 ``[N, L]`` — big-endian packed key bytes.
+      values: int32 ``[N]``.
+      valid: bool ``[N]`` — live entries; replaces empty-key sentinels.
+    """
+
+    key_lanes: jax.Array
+    values: jax.Array
+    valid: jax.Array
+
+    @property
+    def size(self) -> int:
+        return self.key_lanes.shape[0]
+
+    @property
+    def num_lanes(self) -> int:
+        return self.key_lanes.shape[-1]
+
+    def num_valid(self) -> jax.Array:
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def keys_bytes(self) -> jax.Array:
+        """uint8 ``[N, 4L]`` NUL-padded key bytes."""
+        return packing.unpack_keys(self.key_lanes)
+
+    @classmethod
+    def from_bytes(cls, keys: jax.Array, values: jax.Array, valid: jax.Array) -> "KVBatch":
+        return cls(
+            key_lanes=packing.pack_keys(keys),
+            values=values.astype(jnp.int32),
+            valid=valid.astype(bool),
+        )
+
+    @classmethod
+    def empty(cls, n: int, key_lanes: int) -> "KVBatch":
+        return cls(
+            key_lanes=jnp.zeros((n, key_lanes), dtype=jnp.uint32),
+            values=jnp.zeros((n,), dtype=jnp.int32),
+            valid=jnp.zeros((n,), dtype=bool),
+        )
+
+    def to_host_pairs(self) -> list[tuple[bytes, int]]:
+        """Host-side: decode live entries to (key bytes, value) pairs."""
+        keys = jax.device_get(self.keys_bytes())
+        values = jax.device_get(self.values)
+        valid = jax.device_get(self.valid)
+        out = []
+        for k, v, ok in zip(bytes_ops.rows_to_strings(keys), values, valid):
+            if ok:
+                out.append((k, int(v)))
+        return out

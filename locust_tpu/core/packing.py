@@ -1,0 +1,84 @@
+"""Key packing: NUL-padded key bytes <-> big-endian uint32 lanes.
+
+The reference sorts 30-byte keys with a byte-wise comparator loop
+(KIVComparator, reference MapReduce/src/KeyValue.h:20-33).  TPUs sort
+integers far faster than data-dependent byte loops, and byte-wise
+lexicographic order on NUL-padded equal-width keys is *exactly* elementwise
+tuple order on big-endian-packed uint32 lanes — so a key_width-byte key
+becomes key_width/4 uint32 sort operands and ``jax.lax.sort`` with
+``num_keys=key_lanes`` reproduces the comparator's ordering with no
+comparator at all.
+
+Ordering note: we compare bytes as *unsigned* (0..255).  The reference
+compares ``char`` (signed on its platforms), which differs only for
+non-ASCII bytes >= 0x80; documented deliberate divergence (SURVEY.md §7.3).
+NUL-padding means a proper prefix sorts before its extensions, matching
+strcmp semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pack_keys(keys: jax.Array) -> jax.Array:
+    """uint8 ``[..., K]`` -> big-endian uint32 lanes ``[..., K//4]``."""
+    k = keys.shape[-1]
+    if k % 4 != 0:
+        raise ValueError(f"key width {k} not a multiple of 4")
+    r = keys.reshape(*keys.shape[:-1], k // 4, 4).astype(jnp.uint32)
+    return (r[..., 0] << 24) | (r[..., 1] << 16) | (r[..., 2] << 8) | r[..., 3]
+
+
+def unpack_keys(lanes: jax.Array) -> jax.Array:
+    """Big-endian uint32 lanes ``[..., L]`` -> uint8 bytes ``[..., 4L]``."""
+    parts = jnp.stack(
+        [
+            (lanes >> 24) & 0xFF,
+            (lanes >> 16) & 0xFF,
+            (lanes >> 8) & 0xFF,
+            lanes & 0xFF,
+        ],
+        axis=-1,
+    ).astype(jnp.uint8)
+    return parts.reshape(*lanes.shape[:-1], lanes.shape[-1] * 4)
+
+
+def lanes_equal(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Row-wise key equality over the lane dim: ``my_strcmp(...) == 0``."""
+    return jnp.all(a == b, axis=-1)
+
+
+def lanes_less(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Row-wise lexicographic ``a < b`` over big-endian lanes.
+
+    Equivalent to KIVComparator (KeyValue.h:20-33) on the unpacked bytes —
+    without its walk-past-NUL out-of-bounds read on equal keys (SURVEY.md Q3).
+    """
+    # First lane where they differ decides; scan from most significant.
+    neq = a != b
+    first_diff = jnp.argmax(neq, axis=-1)
+    a_at = jnp.take_along_axis(a, first_diff[..., None], axis=-1)[..., 0]
+    b_at = jnp.take_along_axis(b, first_diff[..., None], axis=-1)[..., 0]
+    any_diff = jnp.any(neq, axis=-1)
+    return jnp.where(any_diff, a_at < b_at, False)
+
+
+def fold_hash(lanes: jax.Array) -> jax.Array:
+    """uint32 mixing hash of packed key lanes (for shuffle bucketing).
+
+    FNV-1a-style lane fold followed by a murmur3 finalizer — used by the
+    distributed shuffle to hash-partition keys across mesh devices
+    (SURVEY.md §2.3 "TPU-native plan" for the shuffle).
+    """
+    h = jnp.full(lanes.shape[:-1], 0x811C9DC5, dtype=jnp.uint32)
+    for i in range(lanes.shape[-1]):
+        h = (h ^ lanes[..., i]) * jnp.uint32(0x01000193)
+    # murmur3 fmix32
+    h ^= h >> 16
+    h = h * jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h = h * jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h

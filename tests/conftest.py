@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-device collectives are tested without TPU hardware via
+``xla_force_host_platform_device_count`` — the standard JAX recipe
+(SURVEY.md §4).  Must run before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU tests deterministic and quiet.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
