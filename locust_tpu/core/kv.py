@@ -16,6 +16,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from locust_tpu.core import bytes_ops, packing
 
@@ -67,12 +68,14 @@ class KVBatch:
         )
 
     def to_host_pairs(self) -> list[tuple[bytes, int]]:
-        """Host-side: decode live entries to (key bytes, value) pairs."""
-        keys = jax.device_get(self.keys_bytes())
-        values = jax.device_get(self.values)
-        valid = jax.device_get(self.valid)
-        out = []
-        for k, v, ok in zip(bytes_ops.rows_to_strings(keys), values, valid):
-            if ok:
-                out.append((k, int(v)))
-        return out
+        """Host-side: decode live entries to (key bytes, value) pairs.
+
+        Filters by the validity mask BEFORE decoding so the Python decode
+        loop is O(live entries), not O(table capacity).
+        """
+        valid = np.asarray(jax.device_get(self.valid))
+        keys = np.asarray(jax.device_get(self.keys_bytes()))[valid]
+        values = np.asarray(jax.device_get(self.values))[valid]
+        return [
+            (k, int(v)) for k, v in zip(bytes_ops.rows_to_strings(keys), values)
+        ]

@@ -1,0 +1,255 @@
+"""The MapReduce engine: pluggable map/combine over blocked byte tensors.
+
+Single-device orchestration — the TPU-native analog of the reference driver's
+map -> process -> reduce sequencing (reference MapReduce/src/main.cu:397-473),
+with two deliberate departures:
+
+* **No global line cap.**  The reference truncates input at
+  MAX_LINES_FILE_READ=5800 lines (main.cu:18).  Here the corpus streams
+  through fixed-shape blocks of ``cfg.block_lines`` and partial result tables
+  merge associatively (sort + segment-reduce is a monoid fold), so input
+  size is unbounded (SURVEY.md §5 "long-context").
+* **Pluggable semantics.**  ``map_fn(lines, cfg) -> (KVBatch, overflow)`` and
+  a monoid ``combine`` replace the hardcoded WordCount map()/count-reduce
+  (main.cu:136-153, 210-238); WordCount, PageRank and inverted-index are
+  instances (locust_tpu/apps/).
+
+Every stage is jit-compiled once per config; ``run`` uses one fused program
+per block, ``timed_run`` dispatches stages separately to reproduce the
+reference's per-stage Map/Process/Reduce timing report (main.cu:405-468).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from locust_tpu.config import DEFAULT_CONFIG, EngineConfig
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.ops.map_stage import wordcount_map
+from locust_tpu.ops.process_stage import sort_and_compact
+from locust_tpu.ops.reduce_stage import segment_reduce
+
+logger = logging.getLogger("locust_tpu")
+
+MapFn = Callable[[jax.Array, EngineConfig], tuple[KVBatch, jax.Array]]
+
+
+@dataclasses.dataclass
+class StageTimes:
+    """Per-stage wall-clock, the reference's timing report (main.cu:405-468)."""
+
+    map_ms: float = 0.0
+    process_ms: float = 0.0
+    reduce_ms: float = 0.0
+
+    @property
+    def total_ms(self) -> float:
+        return self.map_ms + self.process_ms + self.reduce_ms
+
+
+@dataclasses.dataclass
+class RunResult:
+    table: KVBatch            # key-sorted unique keys + combined values
+    num_segments: int         # distinct keys found (<= table capacity)
+    overflow_tokens: int      # emits dropped by the per-line cap
+    truncated: bool           # True if distinct keys exceeded table capacity
+    times: StageTimes
+
+    def to_host_pairs(self) -> list[tuple[bytes, int]]:
+        return self.table.to_host_pairs()
+
+
+class MapReduceEngine:
+    """Blocked map/shuffle/reduce on one device (mesh version in parallel/)."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig = DEFAULT_CONFIG,
+        map_fn: MapFn = wordcount_map,
+        combine: str = "sum",
+    ):
+        self.cfg = cfg
+        self.map_fn = map_fn
+        self.combine = combine
+
+        def block_step(lines: jax.Array):
+            kv, overflow = map_fn(lines, cfg)
+            kv = sort_and_compact(kv)
+            return segment_reduce(kv, combine), overflow
+
+        def merge(acc: KVBatch, blk: KVBatch, max_distinct: jax.Array):
+            """Associative table merge, tracking the running max distinct-key
+            count so a capacity truncation in ANY merge is reported, not just
+            the last one."""
+            both = KVBatch(
+                key_lanes=jnp.concatenate([acc.key_lanes, blk.key_lanes]),
+                values=jnp.concatenate([acc.values, blk.values]),
+                valid=jnp.concatenate([acc.valid, blk.valid]),
+            )
+            merged = segment_reduce(sort_and_compact(both), self.combine)
+            new_max = jnp.maximum(max_distinct, merged.num_valid())
+            cap = acc.size
+            head = KVBatch(
+                key_lanes=merged.key_lanes[:cap],
+                values=merged.values[:cap],
+                valid=merged.valid[:cap],
+            )
+            return head, new_max
+
+        def scan_blocks(blocks: jax.Array):
+            """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.
+
+            One device dispatch per corpus instead of per block — essential
+            when dispatch latency is high (remote TPU tunnels) and the XLA-
+            idiomatic way to loop without data-dependent Python control flow.
+            """
+
+            def body(carry, blk):
+                acc, overflow_acc, max_distinct = carry
+                table, overflow = block_step(blk)
+                merged, max_distinct = merge(acc, table, max_distinct)
+                return (merged, overflow_acc + overflow, max_distinct), None
+
+            init = (
+                KVBatch.empty(cfg.emits_per_block, cfg.key_lanes),
+                jnp.int32(0),
+                jnp.int32(0),
+            )
+            (acc, overflow, num), _ = jax.lax.scan(body, init, blocks)
+            return acc, overflow, num
+
+        self._block_step = jax.jit(block_step)
+        self._merge = jax.jit(merge)
+        self._scan_blocks = jax.jit(scan_blocks)
+        # Split stages for the timed path only.
+        self._map = jax.jit(lambda lines: map_fn(lines, cfg))
+        self._process = jax.jit(sort_and_compact)
+        self._reduce = jax.jit(partial(segment_reduce, combine=combine))
+
+    # ---------------------------------------------------------------- ingest
+
+    def rows_from_lines(self, lines: Sequence[bytes]) -> np.ndarray:
+        return bytes_ops.strings_to_rows(list(lines), self.cfg.line_width)
+
+    def _blocks(self, rows: np.ndarray):
+        """Yield fixed-shape [block_lines, line_width] blocks, zero-padded."""
+        bl = self.cfg.block_lines
+        n = rows.shape[0]
+        for i in range(0, max(n, 1), bl):
+            blk = rows[i : i + bl]
+            if blk.shape[0] < bl:
+                pad = np.zeros((bl - blk.shape[0], rows.shape[1]), np.uint8)
+                blk = np.concatenate([blk, pad]) if blk.size else pad
+            yield jnp.asarray(blk)
+
+    # ------------------------------------------------------------------- run
+
+    def run(self, rows: np.ndarray) -> RunResult:
+        """Fused per-block pipeline + associative cross-block merge.
+
+        Keeps overflow/distinct counters on device across the loop — no
+        host sync until the end, so block dispatches pipeline asynchronously.
+        """
+        acc = None
+        overflow = None
+        max_distinct = jnp.int32(0)
+        t0 = time.perf_counter()
+        for blk in self._blocks(rows):
+            table, blk_overflow = self._block_step(blk)
+            overflow = blk_overflow if overflow is None else overflow + blk_overflow
+            if acc is None:
+                acc, max_distinct = table, table.num_valid()
+            else:
+                acc, max_distinct = self._merge(acc, table, max_distinct)
+        jax.block_until_ready(acc.key_lanes)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        return self._finish(acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0))
+
+    def run_fused(self, rows: np.ndarray) -> RunResult:
+        """Whole-corpus run as a single device dispatch (lax.scan over blocks).
+
+        Preferred for throughput: amortizes dispatch latency and lets XLA
+        pipeline block processing.  Compiles once per number-of-blocks; pad
+        the corpus externally to a fixed block count to reuse the executable.
+        """
+        bl, w = self.cfg.block_lines, self.cfg.line_width
+        n = rows.shape[0]
+        nblocks = max(1, -(-n // bl))
+        padded = np.zeros((nblocks * bl, w), dtype=np.uint8)
+        padded[:n] = rows[:, :w]
+        blocks = jnp.asarray(padded.reshape(nblocks, bl, w))
+        t0 = time.perf_counter()
+        acc, overflow, num = self._scan_blocks(blocks)
+        jax.block_until_ready(acc.key_lanes)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        return self._finish(
+            acc, num, int(overflow), StageTimes(0, total_ms, 0)
+        )
+
+    def timed_run(self, rows: np.ndarray) -> RunResult:
+        """Per-stage timing parity with the reference's report (main.cu:405-468).
+
+        Stage boundaries force ``block_until_ready``, so this is slower than
+        ``run``; use it for the stage report, ``run`` for throughput.
+        """
+        acc = None
+        overflow = 0
+        max_distinct = jnp.int32(0)
+        times = StageTimes()
+        for blk in self._blocks(rows):
+            t0 = time.perf_counter()
+            kv, blk_overflow = self._map(blk)
+            jax.block_until_ready(kv.key_lanes)
+            t1 = time.perf_counter()
+            kv = self._process(kv)
+            jax.block_until_ready(kv.key_lanes)
+            t2 = time.perf_counter()
+            table = self._reduce(kv)
+            jax.block_until_ready(table.key_lanes)
+            t3 = time.perf_counter()
+            times.map_ms += (t1 - t0) * 1e3
+            times.process_ms += (t2 - t1) * 1e3
+            times.reduce_ms += (t3 - t2) * 1e3
+            overflow += int(blk_overflow)
+            if acc is None:
+                acc, max_distinct = table, table.num_valid()
+            else:
+                acc, max_distinct = self._merge(acc, table, max_distinct)
+        jax.block_until_ready(acc.key_lanes)
+        return self._finish(acc, max_distinct, overflow, times)
+
+    def run_lines(self, lines: Sequence[bytes]) -> RunResult:
+        return self.run(self.rows_from_lines(lines))
+
+    def _finish(self, acc, num_segments, overflow, times) -> RunResult:
+        num = int(num_segments)
+        truncated = num > acc.size
+        if truncated:
+            logger.warning(
+                "distinct keys (%d) exceeded table capacity (%d); tail dropped",
+                num,
+                acc.size,
+            )
+        if overflow and self.cfg.warn_on_overflow:
+            # Reference: "WARN: Exceeded emit limit" printf (main.cu:141-144).
+            logger.warning(
+                "WARN: Exceeded emit limit — %d tokens beyond %d-per-line cap dropped",
+                overflow,
+                self.cfg.emits_per_line,
+            )
+        return RunResult(
+            table=acc,
+            num_segments=min(num, acc.size),
+            overflow_tokens=overflow,
+            truncated=truncated,
+            times=times,
+        )

@@ -1,0 +1,3 @@
+from locust_tpu.ops.map_stage import tokenize_block, wordcount_map  # noqa: F401
+from locust_tpu.ops.process_stage import sort_and_compact  # noqa: F401
+from locust_tpu.ops.reduce_stage import segment_reduce  # noqa: F401

@@ -1,0 +1,88 @@
+"""Map stage: data-parallel tokenization into fixed-slot KV emits.
+
+TPU-native replacement for the reference's map kernel (``map()``/``kernMap``,
+reference MapReduce/src/main.cu:136-159), which runs one CUDA thread per line
+looping ``my_strtok_r`` sequentially and emitting ``(word, 1)`` into fixed
+slot ``line*EMITS_PER_LINE + count`` with a cap of EMITS_PER_LINE=20
+(main.cu:19,145-147).
+
+Here the whole block tokenizes in one fused pass of vectorized ops:
+delimiter masks -> token-start/end masks -> prefix-sum token ids -> a
+one-hot reduction that turns "the e-th token of line l starts at byte w"
+into a dense ``[lines, emits]`` index table -> a single gather of key bytes.
+No sequential loop, no thread divergence, static shapes throughout.
+
+The fixed-slot emit contract is preserved (same capacity semantics as
+main.cu:145): each line owns ``emits_per_line`` slots; excess tokens are
+dropped and counted (the reference printf-warns and drops, main.cu:141-144).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+
+
+class TokenizeResult(NamedTuple):
+    keys: jax.Array      # uint8 [lines, emits_per_line, key_width]
+    valid: jax.Array     # bool  [lines, emits_per_line]
+    overflow: jax.Array  # int32 [] — tokens dropped beyond the per-line cap
+
+
+def tokenize_block(lines: jax.Array, cfg: EngineConfig) -> TokenizeResult:
+    """Tokenize a ``[block_lines, line_width]`` uint8 block.
+
+    Pure-jnp formulation (the Pallas variant lives in ops/pallas/); XLA fuses
+    the mask/compare chain into a couple of VPU passes plus one gather.
+    """
+    num_lines, width = lines.shape
+    emits, key_w = cfg.emits_per_line, cfg.key_width
+
+    in_token = ~bytes_ops.delimiter_mask(lines)            # [L, W]
+    starts = bytes_ops.token_starts(in_token)              # [L, W]
+    ends = bytes_ops.token_ends(in_token)                  # [L, W]
+    tid = bytes_ops.token_ids(starts)                      # [L, W]
+
+    # Dense slot index tables: start/end byte of the e-th token of each line.
+    slot = jnp.arange(emits, dtype=jnp.int32)              # [E]
+    pos = jnp.arange(width, dtype=jnp.int32)               # [W]
+    start_oh = (starts[..., None] & (tid[..., None] == slot)).astype(jnp.int32)
+    end_oh = (ends[..., None] & (tid[..., None] == slot)).astype(jnp.int32)
+    start_idx = jnp.einsum("lwe,w->le", start_oh, pos)     # [L, E]
+    end_idx = jnp.einsum("lwe,w->le", end_oh, pos)         # [L, E]
+
+    ntok = jnp.sum(starts.astype(jnp.int32), axis=-1)      # [L]
+    valid = slot[None, :] < jnp.minimum(ntok, emits)[:, None]
+    # Token byte length, truncated to the key width (reference truncates via
+    # its 30-byte key field, KeyValue.h:15).
+    tok_len = jnp.clip(end_idx - start_idx + 1, 0, key_w)
+
+    k = jnp.arange(key_w, dtype=jnp.int32)                 # [K]
+    byte_idx = jnp.clip(start_idx[..., None] + k, 0, width - 1)  # [L, E, K]
+    gathered = jnp.take_along_axis(lines[:, None, :], byte_idx, axis=-1)
+    keys = jnp.where(
+        (k < tok_len[..., None]) & valid[..., None], gathered, jnp.uint8(0)
+    )
+
+    overflow = jnp.sum(jnp.maximum(ntok - emits, 0))
+    return TokenizeResult(keys=keys, valid=valid, overflow=overflow)
+
+
+def wordcount_map(lines: jax.Array, cfg: EngineConfig) -> tuple[KVBatch, jax.Array]:
+    """The WordCount map_fn: emit ``(token, 1)`` per token.
+
+    Returns the flat emit batch ``[block_lines * emits_per_line]`` and the
+    overflow counter — the analog of the reference's per-line fixed-slot emit
+    table ``dev_map_kvs[MAX_EMITS]`` (main.cu:20,392).
+    """
+    res = tokenize_block(lines, cfg)
+    flat_keys = res.keys.reshape(-1, cfg.key_width)
+    flat_valid = res.valid.reshape(-1)
+    values = jnp.ones(flat_keys.shape[0], dtype=jnp.int32)
+    return KVBatch.from_bytes(flat_keys, values, flat_valid), res.overflow

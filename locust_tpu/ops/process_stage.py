@@ -1,0 +1,43 @@
+"""Process stage: compaction + key sort in one multi-operand ``lax.sort``.
+
+The reference runs two device passes: ``thrust::partition`` to push empty
+emit slots to the tail (reference MapReduce/src/main.cu:411) then
+``thrust::sort`` with the byte-loop ``KIVComparator`` over the live prefix
+(main.cu:414-415, KeyValue.h:20-33).  That stage is 94% of its GPU runtime
+(reference README.md:72-80) and is the headline perf target (BASELINE.json).
+
+TPU-native formulation: ONE ``jax.lax.sort`` whose most-significant key is
+the inverted validity bit and whose remaining keys are the big-endian uint32
+key lanes.  Sorting ascending then yields exactly "valid entries first, in
+lexicographic key order" — partition and sort fused into a single XLA sort,
+with integer lane compares instead of a data-dependent byte loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from locust_tpu.core.kv import KVBatch
+
+
+def sort_and_compact(batch: KVBatch) -> KVBatch:
+    """Sort by (validity desc, key lex asc), carrying values along.
+
+    Equivalent of partition+sort (main.cu:411-415) as one fused sort.
+    """
+    lanes = batch.key_lanes
+    n_lanes = lanes.shape[-1]
+    invalid = (~batch.valid).astype(jnp.uint32)            # 0 = valid, first
+    operands = (
+        invalid,
+        *(lanes[:, i] for i in range(n_lanes)),
+        batch.values,
+    )
+    out = jax.lax.sort(operands, num_keys=1 + n_lanes)
+    sorted_valid = out[0] == 0
+    sorted_lanes = jnp.stack(out[1 : 1 + n_lanes], axis=-1)
+    sorted_values = out[1 + n_lanes]
+    return KVBatch(
+        key_lanes=sorted_lanes, values=sorted_values, valid=sorted_valid
+    )

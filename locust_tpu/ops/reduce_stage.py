@@ -1,0 +1,80 @@
+"""Reduce stage: segment boundaries + segment combine.
+
+The reference reduces in three device phases (reference
+MapReduce/src/main.cu:161-238,447-465): ``kernFindUniqBool`` marks rows whose
+key differs from the left neighbor, ``thrust::partition`` compacts the
+boundary markers, and ``kernGetCount`` takes adjacent differences of boundary
+indices to recover per-key counts.  That construction is the hand-rolled form
+of a textbook vectorized identity (SURVEY.md §7.1):
+
+    boundary_i  = valid_i & (i == 0 | key_i != key_{i-1})
+    segment_ids = cumsum(boundary) - 1
+    combined    = segment_combine(values, segment_ids)
+
+which is how it is written here — one pass, no phase barriers, and it
+generalizes beyond counting: any monoid (sum/min/max) is a drop-in
+``jax.ops.segment_*``.  The reference's count-by-index-difference only works
+because every value is 1; ``segment_sum`` over the actual values subsumes it.
+
+Input must be key-sorted with valid rows first (ops/process_stage.py), the
+same precondition the reference's reduce has — and which its distributed mode
+silently violates (SURVEY.md Q6); our distributed path re-sorts after the
+shuffle instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from locust_tpu.core.kv import KVBatch
+
+# Monoid combiners available to reduce_fn. "count" treats every value as 1
+# (the reference's WordCount semantics even if upstream emitted other values).
+COMBINERS = ("sum", "min", "max", "count")
+
+
+def segment_reduce(batch: KVBatch, combine: str = "sum") -> KVBatch:
+    """Combine values of equal adjacent keys; output stays key-sorted.
+
+    Returns a same-capacity KVBatch whose first ``num_segments`` rows are the
+    unique keys (in order) with combined values; the tail is invalid.
+    """
+    if combine not in COMBINERS:
+        raise ValueError(f"combine must be one of {COMBINERS}, got {combine!r}")
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    n = lanes.shape[0]
+
+    prev = jnp.roll(lanes, 1, axis=0)
+    neq = jnp.any(lanes != prev, axis=-1)
+    first = jnp.arange(n) == 0
+    boundary = valid & (first | neq)                        # [N]
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1        # [N]
+    ids = jnp.where(valid, seg, n)                          # dump row -> n
+
+    if combine == "sum":
+        combined = jax.ops.segment_sum(values, ids, num_segments=n + 1)
+    elif combine == "count":
+        combined = jax.ops.segment_sum(
+            jnp.ones_like(values), ids, num_segments=n + 1
+        )
+    elif combine == "min":
+        combined = jax.ops.segment_min(values, ids, num_segments=n + 1)
+    else:  # max
+        combined = jax.ops.segment_max(values, ids, num_segments=n + 1)
+    combined = combined[:n]
+
+    # Scatter each segment's first key row to its segment slot.
+    idx = jnp.where(boundary, seg, n)
+    out_lanes = (
+        jnp.zeros((n + 1, lanes.shape[-1]), dtype=lanes.dtype)
+        .at[idx]
+        .set(lanes)[:n]
+    )
+    num_segments = jnp.sum(boundary.astype(jnp.int32))
+    out_valid = jnp.arange(n, dtype=jnp.int32) < num_segments
+    return KVBatch(
+        key_lanes=out_lanes,
+        values=jnp.where(out_valid, combined, 0),
+        valid=out_valid,
+    )

@@ -7,7 +7,10 @@ Multi-device collectives are tested without TPU hardware via
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the ambient environment may export JAX_PLATFORMS=axon (one real
+# TPU chip behind a high-latency tunnel) — tests must run on the virtual
+# 8-device CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,3 +18,29 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 # Keep CPU tests deterministic and quiet.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compile cache: repeat suite runs skip most XLA compiles.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache_cpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# The host environment may inject a remote-TPU PJRT plugin ("axon") into every
+# interpreter via sitecustomize.  jax initializes ALL registered plugins on
+# first backend use even when JAX_PLATFORMS=cpu, so a slow/wedged TPU tunnel
+# would stall pure-CPU tests.  Deregister it for the test process.
+try:
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+# The sitecustomize hook imports jax at interpreter start, BEFORE this file
+# runs — so jax has already captured JAX_PLATFORMS etc. from the ambient env.
+# Override via live config (backends are still uninitialized at this point,
+# so these take effect).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

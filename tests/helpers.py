@@ -1,0 +1,30 @@
+"""Shared test oracles: strtok-semantics tokenization + WordCount Counter.
+
+Single source of truth for the delimiter-split oracle so the engine's
+delimiter set (locust_tpu.config.DELIMITERS) has exactly one mirror here.
+"""
+
+import collections
+import re
+
+from locust_tpu.config import DELIMITERS
+
+_SPLIT = re.compile(b"[" + re.escape(DELIMITERS + b"\n\r\x00") + b"]+")
+
+
+def strtok_tokens(line: bytes, max_tokens=None, key_width=None) -> list[bytes]:
+    """Split like the reference's my_strtok_r loop: delimiters collapse,
+    empty tokens drop; honor the per-line emit cap and key truncation."""
+    toks = [t for t in _SPLIT.split(line) if t]
+    if max_tokens is not None:
+        toks = toks[:max_tokens]
+    if key_width is not None:
+        toks = [t[:key_width] for t in toks]
+    return toks
+
+
+def py_wordcount(lines, max_tokens_per_line=None, key_width=32):
+    c = collections.Counter()
+    for line in lines:
+        c.update(strtok_tokens(line, max_tokens_per_line, key_width))
+    return c

@@ -35,12 +35,7 @@ def test_delimiter_mask_matches_reference_set():
     np.testing.assert_array_equal(mask, expect)
 
 
-def _py_tokens(line: bytes) -> list[bytes]:
-    """strtok-semantics oracle: split on any delimiter, drop empties."""
-    import re
-
-    pat = b"[" + re.escape(DELIMITERS + b"\n\r\x00") + b"]+"
-    return [t for t in re.split(pat, line) if t]
+from helpers import strtok_tokens as _py_tokens
 
 
 @pytest.mark.parametrize(
