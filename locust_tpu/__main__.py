@@ -1,0 +1,3 @@
+from locust_tpu.cli import main
+
+raise SystemExit(main())

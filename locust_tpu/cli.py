@@ -1,0 +1,138 @@
+"""CLI driver: the ``mapreduce <file> [start] [end] [node] [stage]`` contract.
+
+Preserves the reference's positional CLI (reference MapReduce/src/main.cu:362-387)
+and staged execution model:
+
+  stage 0 (or absent)  single mode: map -> process -> reduce, print table
+  stage 1              staged map: process this node's [start, end) line
+                       slice, write the intermediate TSV, exit
+                       ("master will start back up", main.cu:432)
+  stage 2              staged reduce: load intermediate TSV(s), reduce,
+                       print table
+
+Fixes over the reference, each documented in SURVEY.md Appendix A:
+  Q9 — unguarded argv reads -> argparse with the same positional contract.
+  Q6 — the reference's reduce stage never re-sorts loaded intermediate data
+       (correct only if the missing master pre-sorted globally); our reduce
+       stage always sorts, so any concatenation order is correct.
+  Q5/Q10 — clean TSV keys; only live entries written.
+
+Timing report mirrors the reference's three chrono spans (main.cu:405-468)
+— in milliseconds, not its UB %d-of-duration printf (Q7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+STAGE_SINGLE, STAGE_MAP, STAGE_REDUCE = 0, 1, 2
+DEFAULT_INTERMEDIATE = "/tmp/out.txt"  # reference path, main.cu:428
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mapreduce",
+        description="TPU-native MapReduce (WordCount) with staged multi-node mode",
+    )
+    p.add_argument("filename", help="input text file (stage 0/1); ignored for stage 2")
+    p.add_argument("line_start", nargs="?", type=int, default=-1)
+    p.add_argument("line_end", nargs="?", type=int, default=-1)
+    p.add_argument("node_num", nargs="?", type=int, default=0)
+    p.add_argument("stage", nargs="?", type=int, default=STAGE_SINGLE,
+                   choices=[STAGE_SINGLE, STAGE_MAP, STAGE_REDUCE])
+    p.add_argument("--intermediate", "-i", action="append", default=None,
+                   help="intermediate TSV path(s); default "
+                        f"{DEFAULT_INTERMEDIATE} (reference main.cu:428)")
+    p.add_argument("--block-lines", type=int, default=4096)
+    p.add_argument("--line-width", type=int, default=128)
+    p.add_argument("--key-width", type=int, default=32)
+    p.add_argument("--emits-per-line", type=int, default=20)
+    p.add_argument("--no-timing", action="store_true")
+    p.add_argument("--limit", type=int, default=None,
+                   help="print only the first N table rows")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except OSError as e:
+        print(f"mapreduce: error: {e}", file=sys.stderr)
+        return 1
+
+
+def _run(args) -> int:
+
+    # Import jax lazily so --help works instantly.
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.engine import MapReduceEngine
+    from locust_tpu.io import loader, serde
+    import jax.numpy as jnp
+
+    cfg = EngineConfig(
+        block_lines=args.block_lines,
+        line_width=args.line_width,
+        key_width=args.key_width,
+        emits_per_line=args.emits_per_line,
+    )
+    eng = MapReduceEngine(cfg)
+    inter = args.intermediate or [DEFAULT_INTERMEDIATE]
+
+    if args.stage in (STAGE_SINGLE, STAGE_MAP):
+        rows = loader.load_rows(
+            args.filename, cfg.line_width, args.line_start, args.line_end
+        )
+        print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
+        res = eng.timed_run(rows) if not args.no_timing else eng.run_fused(rows)
+        if not args.no_timing:
+            # The reference's per-stage report (README.md:72-88 format).
+            print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)
+            print(f"Process stage: {res.times.process_ms:10.3f} ms", file=sys.stderr)
+            print(f"Reduce stage:  {res.times.reduce_ms:10.3f} ms", file=sys.stderr)
+        if res.truncated:
+            print("[locust] WARN: table capacity exceeded; tail keys dropped",
+                  file=sys.stderr)
+        if args.stage == STAGE_MAP:
+            out = inter[0]
+            serde.write_tsv(res.to_host_pairs(), out)
+            print(f"[locust] node {args.node_num}: intermediate written to {out}",
+                  file=sys.stderr)
+            return 0
+        _print_table(res.to_host_pairs(), args.limit)
+        return 0
+
+    # STAGE_REDUCE: merge intermediate TSVs from map nodes; always re-sort (Q6).
+    key_rows_list, values_list = [], []
+    for path in inter:
+        k, v = serde.read_tsv(path, cfg.key_width)
+        key_rows_list.append(k)
+        values_list.append(v)
+    keys = np.concatenate(key_rows_list) if key_rows_list else np.zeros((0, cfg.key_width), np.uint8)
+    values = np.concatenate(values_list) if values_list else np.zeros((0,), np.int32)
+    print(f"[locust] node {args.node_num}: {keys.shape[0]} intermediate pairs "
+          f"from {len(inter)} file(s)", file=sys.stderr)
+    batch = KVBatch.from_bytes(
+        jnp.asarray(keys), jnp.asarray(values), jnp.ones(keys.shape[0], bool)
+    )
+    from locust_tpu.ops import segment_reduce, sort_and_compact
+
+    table = segment_reduce(sort_and_compact(batch), eng.combine)
+    _print_table(table.to_host_pairs(), args.limit)
+    return 0
+
+
+def _print_table(pairs: list[tuple[bytes, int]], limit=None) -> None:
+    """Final ``key<TAB>count`` table on stdout (analog of printKeyIntValues,
+    main.cu:126-134 — we print two columns, not its internal three)."""
+    for k, v in pairs[: limit if limit is not None else len(pairs)]:
+        sys.stdout.buffer.write(k + b"\t" + str(v).encode() + b"\n")
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+from locust_tpu.io import loader, serde  # noqa: F401

@@ -1,0 +1,61 @@
+"""Corpus ingest: text file -> NUL-padded uint8 line tensors.
+
+Host-side replacement for ``loadFile`` (reference MapReduce/src/main.cu:40-64):
+reads a text file line-by-line honoring a ``[line_start, line_end)`` slice for
+per-node sharding (main.cu:47-54) and produces the padded ``[lines, width]``
+uint8 tensor the device pipeline consumes.
+
+Deliberate fixes vs the reference (SURVEY.md Appendix A):
+  Q1 — the reference drops the final line (``*length = line_num - line_start``
+       with a 0-based max index, main.cu:63); we count correctly.
+  — no MAX_LINES_FILE_READ=5800 hard cap (main.cu:18): ingest streams; the
+    engine blocks the corpus downstream.
+
+A native C++ fast path (native/ingest.cpp, ctypes-loaded) handles large
+corpora; this module is the always-available pure-Python fallback and the
+single public API for both.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from locust_tpu.core import bytes_ops
+
+
+def load_lines(
+    path: str, line_start: int = -1, line_end: int = -1
+) -> list[bytes]:
+    """Read lines, applying the reference's [start, end) node-shard slice.
+
+    ``line_start/line_end of -1`` means "whole file" (reference CLI default,
+    main.cu:369-374).  Out-of-range ends clamp; start beyond EOF yields [].
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.splitlines()
+    if line_start < 0 and line_end < 0:
+        return lines
+    start = max(line_start, 0)
+    end = len(lines) if line_end < 0 else min(line_end, len(lines))
+    return lines[start:end]
+
+
+def load_rows(
+    path: str,
+    line_width: int,
+    line_start: int = -1,
+    line_end: int = -1,
+    use_native: bool = True,
+) -> np.ndarray:
+    """File -> padded ``[lines, line_width]`` uint8 rows (native if built)."""
+    if use_native:
+        try:
+            from locust_tpu.io import native_ingest
+
+            return native_ingest.load_rows(path, line_width, line_start, line_end)
+        except (ImportError, OSError):
+            pass
+    return bytes_ops.strings_to_rows(
+        load_lines(path, line_start, line_end), line_width
+    )

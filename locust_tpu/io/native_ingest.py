@@ -1,0 +1,90 @@
+"""ctypes bindings for the native ingest library (native/ingest.cpp).
+
+Builds the shared object on first use with the system g++ (cached in
+``native/build/``); callers go through io/loader.load_rows which falls back
+to the pure-Python path if the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_SRC = _NATIVE_DIR / "ingest.cpp"
+_SO = _NATIVE_DIR / "build" / "libingest.so"
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> pathlib.Path:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(_SO), str(_SRC)],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        # Surface as OSError so io/loader falls back to the Python path.
+        raise OSError(f"native ingest build failed: {e}") from e
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_build()))
+            lib.ingest_count_lines.restype = ctypes.c_long
+            lib.ingest_count_lines.argtypes = [ctypes.c_char_p]
+            lib.ingest_load_rows.restype = ctypes.c_long
+            lib.ingest_load_rows.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+            ]
+            _lib = lib
+    return _lib
+
+
+def count_lines(path: str) -> int:
+    n = _load().ingest_count_lines(str(path).encode())
+    if n < 0:
+        raise OSError(f"native ingest failed to read {path!r}")
+    return n
+
+
+def load_rows(
+    path: str, line_width: int, line_start: int = -1, line_end: int = -1
+) -> np.ndarray:
+    """File -> padded [rows, line_width] uint8, sliced [line_start, line_end)."""
+    lib = _load()
+    total = count_lines(path)
+    start = max(line_start, 0) if line_start >= 0 else 0
+    end = total if line_end < 0 else min(line_end, total)
+    n_rows = max(end - start, 0)
+    out = np.zeros((n_rows, line_width), dtype=np.uint8)
+    if n_rows == 0:
+        return out
+    wrote = lib.ingest_load_rows(
+        str(path).encode(),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        n_rows,
+        line_width,
+        line_start,
+        line_end,
+    )
+    if wrote < 0:
+        raise OSError(f"native ingest failed to read {path!r}")
+    return out[:wrote] if wrote < n_rows else out

@@ -1,0 +1,80 @@
+"""Intermediate-result serde: the inter-stage / inter-process data plane.
+
+The reference's only inter-process format is a ``key\\tvalue`` TSV at
+``/tmp/out.txt`` written by the map stage (``writeKeyIntValues``, reference
+MapReduce/src/main.cu:116-124) and re-read by the reduce stage
+(``loadIntermediateFile``, main.cu:66-103).  That file is also its entire
+checkpoint/resume story (SURVEY.md §5).
+
+Kept for CLI/staged-mode parity, with fixes:
+  Q5  — the reference writes a trailing space in every key (``"%s \\t%d"``,
+        main.cu:121); we write clean ``key\\tvalue`` but *accept* trailing
+        spaces on read for compatibility with reference-produced files.
+  Q10 — the reference dumps the full uncompacted MAX_EMITS buffer; we write
+        only live entries.
+
+For TPU-shard checkpoints (stage-level resume at scale) the binary ``npz``
+format stores the packed device representation directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+
+
+def write_tsv(pairs: list[tuple[bytes, int]], path: str) -> None:
+    """Write live (key, value) pairs as ``key\\tvalue`` lines."""
+    with open(path, "wb") as f:
+        for k, v in pairs:
+            f.write(k + b"\t" + str(int(v)).encode() + b"\n")
+
+
+def read_tsv(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Parse ``key\\tvalue`` TSV -> (padded key rows, int32 values).
+
+    Split on the FIRST tab like the reference's parser (main.cu:84-97);
+    tolerate reference-style trailing spaces in keys (Q5) and blank lines.
+    """
+    keys: list[bytes] = []
+    values: list[int] = []
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.rstrip(b"\n").rstrip(b"\r")
+            if not line:
+                continue
+            key, _, val = line.partition(b"\t")
+            key = key.rstrip(b" ")  # reference writes "key \t..." (Q5)
+            if not key:
+                continue
+            try:
+                values.append(int(val))
+            except ValueError:
+                continue  # malformed row: skip, like the reference's atoi-0 rows
+            keys.append(key)
+    return bytes_ops.strings_to_rows(keys, key_width), np.asarray(
+        values, dtype=np.int32
+    )
+
+
+def write_npz(batch: KVBatch, path: str) -> None:
+    """Binary shard checkpoint: the packed device representation as-is."""
+    np.savez_compressed(
+        path,
+        key_lanes=np.asarray(batch.key_lanes),
+        values=np.asarray(batch.values),
+        valid=np.asarray(batch.valid),
+    )
+
+
+def read_npz(path: str) -> KVBatch:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        return KVBatch(
+            key_lanes=jnp.asarray(z["key_lanes"]),
+            values=jnp.asarray(z["values"]),
+            valid=jnp.asarray(z["valid"]),
+        )

@@ -1,0 +1,102 @@
+// Native corpus ingest: file -> NUL-padded fixed-width line rows.
+//
+// TPU-native equivalent of the reference's host ingest (loadFile,
+// reference MapReduce/src/main.cu:40-64): the reference reads with a
+// getline loop into 204-byte structs; here one buffered read + a single
+// scan splits lines and pads them straight into the caller's contiguous
+// [max_lines, width] uint8 buffer, which the Python side hands to
+// jnp.asarray with zero further copies.  Honors the same [line_start,
+// line_end) node-shard slice (main.cu:47-54) and fixes the reference's
+// dropped-final-line off-by-one (SURVEY.md Q1).
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this toolchain).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// Reads the whole file; returns malloc'd buffer (caller frees) or nullptr.
+char* read_file(const char* path, long* size_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  char* buf = static_cast<char*>(std::malloc(size > 0 ? size : 1));
+  if (!buf) {
+    std::fclose(f);
+    return nullptr;
+  }
+  long got = static_cast<long>(std::fread(buf, 1, size, f));
+  std::fclose(f);
+  if (got != size) {
+    std::free(buf);
+    return nullptr;
+  }
+  *size_out = size;
+  return buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of lines in the file ('\n'-separated; a trailing fragment without
+// a newline counts — the Q1 fix).  Returns -1 on I/O error.
+long ingest_count_lines(const char* path) {
+  long size = 0;
+  char* buf = read_file(path, &size);
+  if (!buf) return -1;
+  long lines = 0;
+  bool in_line = false;
+  for (long i = 0; i < size; ++i) {
+    if (buf[i] == '\n') {
+      ++lines;
+      in_line = false;
+    } else {
+      in_line = true;
+    }
+  }
+  if (in_line) ++lines;
+  std::free(buf);
+  return lines;
+}
+
+// Load lines [line_start, line_end) into out[max_lines][width], NUL-padded,
+// '\r' stripped at line end, content truncated to width.  Negative
+// start/end mean "whole file" (reference CLI default, main.cu:369-374).
+// Returns rows written, or -1 on I/O error.
+long ingest_load_rows(const char* path, unsigned char* out, long max_lines,
+                      long width, long line_start, long line_end) {
+  long size = 0;
+  char* buf = read_file(path, &size);
+  if (!buf) return -1;
+  long start = line_start < 0 ? 0 : line_start;
+  long end = line_end < 0 ? -1 : line_end;  // -1 = unbounded
+
+  std::memset(out, 0, static_cast<size_t>(max_lines) * width);
+  long line = 0, row = 0;
+  long pos = 0;
+  while (pos <= size - 1 || (pos == 0 && size == 0)) {
+    if (pos >= size) break;
+    // Find line extent [pos, eol).
+    long eol = pos;
+    while (eol < size && buf[eol] != '\n') ++eol;
+    if (line >= start && (end < 0 || line < end) && row < max_lines) {
+      long len = eol - pos;
+      if (len > 0 && buf[pos + len - 1] == '\r') --len;  // CRLF
+      if (len > width) len = width;
+      std::memcpy(out + row * width, buf + pos, len);
+      ++row;
+    }
+    ++line;
+    pos = eol + 1;
+    if (end >= 0 && line >= end) break;
+  }
+  std::free(buf);
+  return row;
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+"""CLI contract tests: single mode, staged map/reduce, robust args (Q9)."""
+
+import numpy as np
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu import cli
+
+
+CORPUS = b"""to be or not to be
+that is the question
+to be, to sleep
+"""
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    p = tmp_path / "in.txt"
+    p.write_bytes(CORPUS)
+    return str(p)
+
+
+def _cfg_args():
+    return ["--block-lines", "8", "--line-width", "64", "--emits-per-line", "8"]
+
+
+def _parse_table(out: bytes) -> dict[bytes, int]:
+    table = {}
+    for line in out.splitlines():
+        if not line:
+            continue
+        k, _, v = line.partition(b"\t")
+        table[k] = int(v)
+    return table
+
+
+def test_cli_single_mode(corpus_file, capsysbinary):
+    rc = cli.main([corpus_file] + _cfg_args())
+    assert rc == 0
+    got = _parse_table(capsysbinary.readouterr().out)
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+def test_cli_line_range_sharding(corpus_file, capsysbinary):
+    rc = cli.main([corpus_file, "0", "1"] + _cfg_args())
+    assert rc == 0
+    got = _parse_table(capsysbinary.readouterr().out)
+    assert got == dict(py_wordcount([CORPUS.splitlines()[0]], 8))
+
+
+def test_cli_staged_map_then_reduce(corpus_file, tmp_path, capsysbinary):
+    """Two map nodes shard the file; the reduce node merges both TSVs —
+    the reference's distributed flow (SURVEY.md §3.2-3.3) minus the bugs."""
+    t1, t2 = str(tmp_path / "n1.tsv"), str(tmp_path / "n2.tsv")
+    assert cli.main([corpus_file, "0", "2", "1", "1", "-i", t1] + _cfg_args()) == 0
+    assert cli.main([corpus_file, "2", "-1", "2", "1", "-i", t2] + _cfg_args()) == 0
+    capsysbinary.readouterr()  # drop map-stage stdout
+    rc = cli.main([corpus_file, "-1", "-1", "0", "2", "-i", t1, "-i", t2] + _cfg_args())
+    assert rc == 0
+    got = _parse_table(capsysbinary.readouterr().out)
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+def test_cli_reduce_reorders_unsorted_input(tmp_path, capsysbinary):
+    """Q6 fix: reduce must be correct for ANY intermediate ordering."""
+    t = str(tmp_path / "x.tsv")
+    with open(t, "wb") as f:
+        f.write(b"zebra\t1\napple\t2\nzebra\t3\napple\t1\nmid\t5\n")
+    rc = cli.main(["ignored.txt", "-1", "-1", "0", "2", "-i", t] + _cfg_args())
+    assert rc == 0
+    out = capsysbinary.readouterr().out
+    got = _parse_table(out)
+    assert got == {b"apple": 3, b"mid": 5, b"zebra": 4}
+    assert list(got) == sorted(got)  # output sorted even from unsorted input
+
+
+def test_cli_bad_stage_rejected(corpus_file, capsys):
+    with pytest.raises(SystemExit):
+        cli.main([corpus_file, "0", "1", "0", "9"])
+
+
+def test_cli_limit(corpus_file, capsysbinary):
+    assert cli.main([corpus_file, "--limit", "2"] + _cfg_args()) == 0
+    assert len(capsysbinary.readouterr().out.splitlines()) == 2

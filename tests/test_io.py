@@ -1,0 +1,103 @@
+"""IO tests: loader slicing (incl. Q1 fix), TSV/npz serde, native ingest parity."""
+
+import numpy as np
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.io import loader, serde
+
+
+CORPUS = b"first line\nsecond, line\nthird-line\r\nfourth\nlast without newline"
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(CORPUS)
+    return str(p)
+
+
+def test_load_lines_whole_file_keeps_last_line(corpus_file):
+    # Q1: the reference drops the final line; we must not.
+    lines = loader.load_lines(corpus_file)
+    assert len(lines) == 5
+    assert lines[-1] == b"last without newline"
+
+
+def test_load_lines_slice_semantics(corpus_file):
+    assert loader.load_lines(corpus_file, 1, 3) == [b"second, line", b"third-line"]
+    assert loader.load_lines(corpus_file, 3, 100) == [
+        b"fourth",
+        b"last without newline",
+    ]
+    assert loader.load_lines(corpus_file, 99, 200) == []
+
+
+def test_load_rows_python_fallback(corpus_file):
+    rows = loader.load_rows(corpus_file, 32, use_native=False)
+    assert rows.shape == (5, 32)
+    assert bytes_ops.rows_to_strings(rows)[0] == b"first line"
+    # CR stripped from CRLF line
+    assert bytes_ops.rows_to_strings(rows)[2] == b"third-line"
+
+
+def test_native_ingest_matches_python(corpus_file):
+    pytest.importorskip("locust_tpu.io.native_ingest")
+    from locust_tpu.io import native_ingest
+
+    try:
+        native = native_ingest.load_rows(corpus_file, 32)
+    except (OSError, Exception) as e:  # toolchain missing
+        pytest.skip(f"native build unavailable: {e}")
+    py = loader.load_rows(corpus_file, 32, use_native=False)
+    np.testing.assert_array_equal(native, py)
+    for sl in [(-1, -1), (1, 3), (0, 2), (4, 99), (2, 2)]:
+        np.testing.assert_array_equal(
+            native_ingest.load_rows(corpus_file, 16, *sl),
+            loader.load_rows(corpus_file, 16, *sl, use_native=False),
+        )
+
+
+def test_native_ingest_long_line_truncates(tmp_path):
+    from locust_tpu.io import native_ingest
+
+    p = tmp_path / "long.txt"
+    p.write_bytes(b"x" * 300 + b"\nshort\n")
+    try:
+        rows = native_ingest.load_rows(str(p), 64)
+    except Exception as e:
+        pytest.skip(f"native build unavailable: {e}")
+    assert bytes_ops.rows_to_strings(rows) == [b"x" * 64, b"short"]
+
+
+def test_tsv_roundtrip(tmp_path):
+    pairs = [(b"the", 143), (b"to", 123), (b"question", 1)]
+    path = str(tmp_path / "out.tsv")
+    serde.write_tsv(pairs, path)
+    keys, values = serde.read_tsv(path, 32)
+    assert bytes_ops.rows_to_strings(keys) == [k for k, _ in pairs]
+    assert values.tolist() == [v for _, v in pairs]
+
+
+def test_tsv_accepts_reference_trailing_space(tmp_path):
+    # Q5: the reference writes "key \tvalue"; we must read it cleanly.
+    path = str(tmp_path / "ref.tsv")
+    with open(path, "wb") as f:
+        f.write(b"word \t7\n\n junk-no-tab\nvalid\t3\n")
+    keys, values = serde.read_tsv(path, 32)
+    assert bytes_ops.rows_to_strings(keys) == [b"word", b"valid"]
+    assert values.tolist() == [7, 3]
+
+
+def test_npz_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    keys = jnp.asarray(bytes_ops.strings_to_rows([b"alpha", b"beta"], 32))
+    batch = KVBatch.from_bytes(keys, jnp.asarray([1, 2]), jnp.asarray([1, 1], bool))
+    path = str(tmp_path / "shard.npz")
+    serde.write_npz(batch, path)
+    back = serde.read_npz(path)
+    assert back.to_host_pairs() == [(b"alpha", 1), (b"beta", 2)]
