@@ -1,0 +1,11 @@
+from locust_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    initialize_multihost,
+    make_mesh,
+    shard_rows,
+)
+from locust_tpu.parallel.shuffle import (  # noqa: F401
+    DistributedMapReduce,
+    DistributedResult,
+    partition_to_bins,
+)

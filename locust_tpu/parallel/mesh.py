@@ -1,0 +1,59 @@
+"""Device mesh construction + multi-host runtime init.
+
+Replaces the reference's distribution substrate — a hand-rolled TCP
+command channel (reference Distributor/slave.py:5-20) with data staged
+through ``/tmp/out.txt`` files (main.cu:428-441) — with the JAX distributed
+runtime: ``jax.distributed.initialize`` for the control plane (coordination
+service; no hand-rolled sockets) and a ``jax.sharding.Mesh`` over all
+devices for the data plane, where the shuffle rides ICI collectives
+(SURVEY.md §5 "Distributed communication backend").
+
+Mesh axes:
+  "data"  — line/corpus sharding (the reference's per-node [start, end)
+            line ranges, main.cu:47-54) AND the hash-shuffle axis.
+A single axis suffices for MapReduce (there is no tensor/pipeline dimension
+in this workload class); multi-host pods put hosts x local-chips into one
+flat axis so the all-to-all crosses ICI within a slice and DCN across.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> jax.sharding.Mesh:
+    """1-D mesh over the first ``n_devices`` (default: all) devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return jax.sharding.Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the JAX coordination service (multi-host pods).
+
+    The launcher (locust_tpu/distributor/) passes these per-worker; inside
+    managed TPU environments all three are auto-detected and may be None.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def shard_rows(rows: np.ndarray, mesh: jax.sharding.Mesh, axis_name: str = DATA_AXIS):
+    """Place host rows onto the mesh, sharded along the line dimension."""
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis_name)
+    )
+    return jax.device_put(rows, sharding)

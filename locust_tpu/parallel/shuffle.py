@@ -1,0 +1,239 @@
+"""Distributed shuffle: hash-partition + ICI all-to-all + per-shard reduce.
+
+This is the component the reference never actually shipped: its multi-node
+data plane is "write /tmp/out.txt, let an out-of-repo script move it"
+(reference MapReduce/src/main.cu:421-446; the master is MISSING, SURVEY.md
+C12), and its reduce stage doesn't even re-sort the merged input (Q6).
+
+TPU-native design (BASELINE.json north star):
+
+  1. Each device runs the local pipeline on its line shard — map, then a
+     LOCAL combine (sort + segment-reduce).  Pre-aggregation is the classic
+     MapReduce combiner: hot keys ("the") collapse to ONE (key, partial)
+     entry per device before they ever hit the network, which is also what
+     defuses the skewed-shuffle problem (SURVEY.md §7.3.3).
+  2. Keys hash-partition across devices (fold_hash % n); entries scatter
+     into equal-capacity per-destination bins (XLA all-to-all needs equal
+     splits; capacity = fair share x skew_factor, overflow counted).
+  3. One ``lax.all_to_all`` over the mesh axis — the ICI shuffle.
+  4. Each device sorts + segment-reduces what it received: its hash shard
+     of the global table, key-sorted within the shard.
+  5. Scalar stats (overflow counters, distinct counts) combine via psum.
+
+Deterministic: every stage is a sort or a segment op; shard contents are
+fully determined by the hash function and key order.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import packing
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.ops.map_stage import wordcount_map
+from locust_tpu.ops.process_stage import sort_and_compact
+from locust_tpu.ops.reduce_stage import segment_reduce
+from locust_tpu.parallel.mesh import DATA_AXIS
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def partition_to_bins(
+    batch: KVBatch, n_bins: int, bin_capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Scatter a batch into ``[n_bins, capacity]`` by key hash.
+
+    Returns (lanes [B,C,L], values [B,C], valid [B,C], overflow []) where
+    overflow counts live entries dropped because their bin was full.
+    """
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    n, n_lanes = lanes.shape
+    bucket = (packing.fold_hash(lanes) % n_bins).astype(jnp.uint32)
+    bucket = jnp.where(valid, bucket, n_bins)  # invalid -> sentinel bin
+
+    # Group by bin (stable overall ordering: bin, then key lanes).
+    ops = (bucket, *(lanes[:, i] for i in range(n_lanes)), values)
+    s = jax.lax.sort(ops, num_keys=1 + n_lanes)
+    sb = s[0].astype(jnp.int32)
+    slanes = jnp.stack(s[1 : 1 + n_lanes], axis=-1)
+    svals = s[1 + n_lanes]
+    svalid = sb < n_bins
+
+    # Rank within bin = index - bin start offset.
+    ones = jnp.ones_like(sb)
+    counts = jax.ops.segment_sum(ones, sb, num_segments=n_bins + 1)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    within = jnp.arange(n, dtype=jnp.int32) - offsets[sb]
+
+    ok = svalid & (within < bin_capacity)
+    overflow = jnp.sum((svalid & (within >= bin_capacity)).astype(jnp.int32))
+    dump = n_bins * bin_capacity
+    dest = jnp.where(ok, sb * bin_capacity + within, dump)
+
+    flat = n_bins * bin_capacity
+    out_lanes = (
+        jnp.zeros((flat + 1, n_lanes), lanes.dtype).at[dest].set(slanes)[:flat]
+    ).reshape(n_bins, bin_capacity, n_lanes)
+    out_vals = (
+        jnp.zeros((flat + 1,), svals.dtype).at[dest].set(svals)[:flat]
+    ).reshape(n_bins, bin_capacity)
+    out_valid = (
+        jnp.zeros((flat + 1,), bool).at[dest].set(ok)[:flat]
+    ).reshape(n_bins, bin_capacity)
+    return out_lanes, out_vals, out_valid, overflow
+
+
+class DistributedMapReduce:
+    """Mesh-parallel MapReduce: shard_map(local pipeline + all-to-all).
+
+    Processes the corpus in rounds of ``n_devices * cfg.block_lines`` lines;
+    each device carries its hash shard of the result table across rounds
+    (consistent hash partitioning makes the per-shard merge local — no
+    cross-device traffic outside the one all-to-all per round).
+    """
+
+    def __init__(
+        self,
+        mesh: jax.sharding.Mesh,
+        cfg: EngineConfig,
+        axis_name: str = DATA_AXIS,
+        map_fn=wordcount_map,
+        combine: str = "sum",
+        skew_factor: float = 2.0,
+    ):
+        self.mesh = mesh
+        self.cfg = cfg
+        self.axis = axis_name
+        self.combine = combine
+        self.n_dev = mesh.shape[axis_name]
+        # Per-destination bin capacity: fair share of the local table,
+        # padded for skew, TPU-lane aligned.
+        self.bin_capacity = _round_up(
+            max(1, math.ceil(cfg.emits_per_block / self.n_dev * skew_factor)), 8
+        )
+        # Received rows per device per round; also the shard table capacity.
+        self.shard_capacity = self.n_dev * self.bin_capacity
+        n_lanes = cfg.key_lanes
+        axis = axis_name
+
+        def local_step(lines: jax.Array, acc: KVBatch):
+            """Per-device body (runs under shard_map)."""
+            kv, emit_ovf = map_fn(lines, cfg)
+            local_table = segment_reduce(sort_and_compact(kv), combine)
+
+            send_lanes, send_vals, send_valid, shuf_ovf = partition_to_bins(
+                local_table, self.n_dev, self.bin_capacity
+            )
+            # The ICI shuffle: one all-to-all per tensor.
+            recv_lanes = jax.lax.all_to_all(send_lanes, axis, 0, 0)
+            recv_vals = jax.lax.all_to_all(send_vals, axis, 0, 0)
+            recv_valid = jax.lax.all_to_all(send_valid, axis, 0, 0)
+
+            received = KVBatch(
+                key_lanes=recv_lanes.reshape(-1, n_lanes),
+                values=recv_vals.reshape(-1),
+                valid=recv_valid.reshape(-1),
+            )
+            # Merge what we received with our carried shard, re-reduce.
+            both = KVBatch(
+                key_lanes=jnp.concatenate([acc.key_lanes, received.key_lanes]),
+                values=jnp.concatenate([acc.values, received.values]),
+                valid=jnp.concatenate([acc.valid, received.valid]),
+            )
+            merged = segment_reduce(sort_and_compact(both), combine)
+            distinct = merged.num_valid()
+            new_acc = KVBatch(
+                key_lanes=merged.key_lanes[: self.shard_capacity],
+                values=merged.values[: self.shard_capacity],
+                valid=merged.valid[: self.shard_capacity],
+            )
+            # Global scalar stats ride psum — the "final combine" collective.
+            stats = jnp.stack(
+                [
+                    jax.lax.psum(emit_ovf, axis),
+                    jax.lax.psum(shuf_ovf, axis),
+                    jax.lax.psum(distinct, axis),
+                ]
+            )
+            return new_acc, stats[None]  # [1, 3] per device
+
+        kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
+        self._step = jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(P(axis), kv_spec),
+                out_specs=(kv_spec, P(axis)),
+            )
+        )
+
+    # ------------------------------------------------------------------ api
+
+    @property
+    def lines_per_round(self) -> int:
+        return self.n_dev * self.cfg.block_lines
+
+    def empty_table(self) -> KVBatch:
+        """Global (sharded) empty accumulator: one shard per device."""
+        return KVBatch.empty(self.n_dev * self.shard_capacity, self.cfg.key_lanes)
+
+    def run(self, rows, shard_fn=None) -> "DistributedResult":
+        """Run the full corpus; ``rows`` is a host ``[n, line_width]`` array."""
+        import numpy as np
+
+        from locust_tpu.parallel.mesh import shard_rows
+
+        lpr = self.lines_per_round
+        n = rows.shape[0]
+        nrounds = max(1, -(-n // lpr))
+        acc = jax.device_put(
+            self.empty_table(),
+            jax.sharding.NamedSharding(self.mesh, P(self.axis)),
+        )
+        emit_ovf = shuf_ovf = 0
+        distinct = 0
+        for r in range(nrounds):
+            chunk = rows[r * lpr : (r + 1) * lpr]
+            if chunk.shape[0] < lpr:
+                pad = np.zeros((lpr - chunk.shape[0], rows.shape[1]), np.uint8)
+                chunk = np.concatenate([chunk, pad]) if chunk.size else pad
+            sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
+            acc, stats = self._step(sharded, acc)
+            # Overflows accumulate across rounds; distinct is a property of
+            # the final merged table, so the last round's value stands.
+            round_stats = jax.device_get(stats)[0]
+            emit_ovf += int(round_stats[0])
+            shuf_ovf += int(round_stats[1])
+            distinct = int(round_stats[2])
+        return DistributedResult(
+            table=acc,
+            emit_overflow=emit_ovf,
+            shuffle_overflow=shuf_ovf,
+            distinct=distinct,
+        )
+
+
+class DistributedResult:
+    def __init__(self, table: KVBatch, emit_overflow: int, shuffle_overflow: int, distinct: int):
+        self.table = table
+        self.emit_overflow = emit_overflow
+        self.shuffle_overflow = shuffle_overflow
+        self.distinct = distinct
+
+    def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
+        """Gather all shards; optionally re-sort to global key order.
+
+        Shards are hash-partitioned (each internally key-sorted), so global
+        lexicographic order needs this final host-side merge — the analog of
+        the reference's final sorted print (main.cu:473).
+        """
+        pairs = self.table.to_host_pairs()
+        return sorted(pairs) if sort else pairs

@@ -1,0 +1,117 @@
+"""Distributed shuffle tests on the 8-device virtual CPU mesh.
+
+The all-to-all + psum path runs on real collectives here (XLA CPU backend),
+which is the standard JAX recipe for testing multi-device code without a pod
+(SURVEY.md §4, §7.3.5).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import bytes_ops, packing
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.parallel import DistributedMapReduce, make_mesh, partition_to_bins
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def small_cfg(**kw):
+    kw.setdefault("block_lines", 16)
+    kw.setdefault("line_width", 64)
+    kw.setdefault("emits_per_line", 8)
+    return EngineConfig(**kw)
+
+
+def test_partition_to_bins_routes_by_hash():
+    words = [f"w{i}".encode() for i in range(50)]
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
+    batch = KVBatch.from_bytes(
+        keys, jnp.arange(50), jnp.ones(50, bool)
+    )
+    lanes, vals, valid, overflow = partition_to_bins(batch, 4, 32)
+    assert lanes.shape == (4, 32, 8) and int(overflow) == 0
+    # Every live entry landed in the bin its hash names.
+    h = np.asarray(packing.fold_hash(batch.key_lanes)) % 4
+    got_per_bin = [int(np.asarray(valid[b]).sum()) for b in range(4)]
+    expect_per_bin = [int((h == b).sum()) for b in range(4)]
+    assert got_per_bin == expect_per_bin
+
+
+def test_partition_overflow_counted():
+    words = [b"same"] * 20  # all hash to one bin
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
+    batch = KVBatch.from_bytes(keys, jnp.ones(20, jnp.int32), jnp.ones(20, bool))
+    _, _, valid, overflow = partition_to_bins(batch, 4, 8)
+    assert int(overflow) == 12 and int(np.asarray(valid).sum()) == 8
+
+
+def test_distributed_wordcount_matches_oracle():
+    mesh = make_mesh(8)
+    cfg = small_cfg()
+    dmr = DistributedMapReduce(mesh, cfg)
+    rng = np.random.default_rng(7)
+    vocab = [f"word{i}".encode() for i in range(60)] + [b"the"] * 5
+    lines = [
+        b" ".join(rng.choice(vocab, size=rng.integers(0, 7)).tolist())
+        for _ in range(300)
+    ]
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    expect = py_wordcount(lines, cfg.emits_per_line, cfg.key_width)
+    assert dict(res.to_host_pairs()) == dict(expect)
+    assert res.shuffle_overflow == 0
+    assert res.distinct == len(expect)
+
+
+def test_distributed_multi_round_carries_shards():
+    mesh = make_mesh(8)
+    cfg = small_cfg(block_lines=4)  # lines_per_round = 32 -> several rounds
+    dmr = DistributedMapReduce(mesh, cfg)
+    lines = [b"alpha beta", b"beta gamma", b"alpha"] * 40
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    assert dict(res.to_host_pairs()) == dict(py_wordcount(lines, cfg.emits_per_line))
+
+
+def test_distributed_hot_key_skew_pre_aggregated():
+    """A pathologically hot key must NOT overflow the shuffle bins thanks to
+    the local combiner (one entry per device per key)."""
+    mesh = make_mesh(8)
+    cfg = small_cfg()
+    dmr = DistributedMapReduce(mesh, cfg, skew_factor=1.5)
+    lines = [b"the the the the the the"] * 128
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    assert res.shuffle_overflow == 0
+    assert dict(res.to_host_pairs()) == {b"the": 6 * 128}
+
+
+def test_distributed_overflow_accumulates_across_rounds():
+    """Regression: emit overflow in an EARLY round must be reported even when
+    later rounds are clean."""
+    mesh = make_mesh(8)
+    cfg = small_cfg(block_lines=2, emits_per_line=4)  # 16 lines per round
+    dmr = DistributedMapReduce(mesh, cfg)
+    busy = [b"a b c d e f"] * 16   # round 0: 2 dropped tokens per line
+    clean = [b"x y"] * 16          # round 1: no overflow
+    rows = bytes_ops.strings_to_rows(busy + clean, cfg.line_width)
+    res = dmr.run(rows)
+    assert res.emit_overflow == 2 * 16
+
+
+def test_distributed_output_sorted():
+    mesh = make_mesh(8)
+    cfg = small_cfg()
+    dmr = DistributedMapReduce(mesh, cfg)
+    lines = [b"zeta alpha mid", b"beta zeta"]
+    res = dmr.run(bytes_ops.strings_to_rows(lines, cfg.line_width))
+    keys = [k for k, _ in res.to_host_pairs()]
+    assert keys == sorted(keys)
