@@ -1,0 +1,2 @@
+from locust_tpu.apps.inverted_index import build_inverted_index  # noqa: F401
+from locust_tpu.apps.pagerank import DistributedPageRank, pagerank  # noqa: F401

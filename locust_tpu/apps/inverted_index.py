@@ -1,0 +1,120 @@
+"""Inverted index: word -> sorted unique doc ids (BASELINE.json configs[4]).
+
+The stretch workload: emits are (word, doc_id) and the reduce is "collect
+the distinct values per key" — a variable-length output that stresses the
+fixed-slot emit contract (SURVEY.md §7.2 M5).
+
+TPU-native formulation with static shapes throughout:
+
+  1. Map: tokenize lines (ops/map_stage), value = the line's doc id.
+  2. Sort by (validity, key, value): ONE multi-operand sort groups words
+     AND orders each word's doc ids — num_keys covers the value too.
+  3. Dedup (word, doc) pairs with a boundary mask on pair equality, then
+     one more sort-compact pushes surviving pairs to the prefix.
+  4. Word segment boundaries over the deduped prefix give the postings
+     offsets: the index is (concatenated doc-id postings, per-word counts)
+     — the standard CSR layout, assembled on host into {word: [doc ids]}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.ops.map_stage import tokenize_block
+from locust_tpu.ops.reduce_stage import segment_reduce
+
+
+def _sort_pairs(batch: KVBatch) -> KVBatch:
+    """Sort by (validity desc, key lex, value asc) — values are sort keys too."""
+    lanes = batch.key_lanes
+    n_lanes = lanes.shape[-1]
+    invalid = (~batch.valid).astype(jnp.uint32)
+    ops = (invalid, *(lanes[:, i] for i in range(n_lanes)), batch.values)
+    out = jax.lax.sort(ops, num_keys=2 + n_lanes)  # value participates
+    return KVBatch(
+        key_lanes=jnp.stack(out[1 : 1 + n_lanes], axis=-1),
+        values=out[1 + n_lanes],
+        valid=out[0] == 0,
+    )
+
+
+def _index_block(lines: jax.Array, doc_ids: jax.Array, cfg: EngineConfig):
+    """One block -> (word rows, postings doc ids, per-word counts, n_words)."""
+    res = tokenize_block(lines, cfg)
+    flat_keys = res.keys.reshape(-1, cfg.key_width)
+    flat_valid = res.valid.reshape(-1)
+    values = jnp.repeat(doc_ids.astype(jnp.int32), cfg.emits_per_line)
+    batch = KVBatch.from_bytes(flat_keys, values, flat_valid)
+
+    s = _sort_pairs(batch)
+    n = s.size
+    # Dedup identical (word, doc) pairs: keep first of each run.
+    prev_lanes = jnp.roll(s.key_lanes, 1, axis=0)
+    prev_vals = jnp.roll(s.values, 1)
+    first = jnp.arange(n) == 0
+    pair_new = first | jnp.any(s.key_lanes != prev_lanes, axis=-1) | (
+        s.values != prev_vals
+    )
+    deduped = KVBatch(
+        key_lanes=s.key_lanes, values=s.values, valid=s.valid & pair_new
+    )
+    d = _sort_pairs(deduped)  # compact survivors to the prefix, still ordered
+
+    # Per-word postings counts via segment reduce with combine="count".
+    counts = segment_reduce(d, "count")
+    return d, counts, res.overflow
+
+
+# Module-level jit: one compile per (shapes, cfg), shared across calls.
+_index_block_jit = jax.jit(_index_block, static_argnames="cfg")
+
+
+def build_inverted_index(
+    lines: list[bytes] | np.ndarray,
+    doc_ids: np.ndarray,
+    cfg: EngineConfig | None = None,
+) -> dict[bytes, list[int]]:
+    """Host API: lines + per-line doc ids -> {word: sorted unique doc ids}.
+
+    Single-block for now (cap: cfg.block_lines lines per call); the engine's
+    merge machinery extends this to streamed corpora the same way WordCount
+    merges block tables.
+    """
+    cfg = cfg or EngineConfig()
+    if not isinstance(lines, np.ndarray):
+        rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
+    else:
+        rows = lines
+    n = rows.shape[0]
+    if n > cfg.block_lines:
+        raise ValueError(
+            f"{n} lines exceed block capacity {cfg.block_lines}; "
+            "raise cfg.block_lines or chunk the corpus"
+        )
+    pad = cfg.block_lines - n
+    rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
+    ids = np.concatenate([np.asarray(doc_ids, np.int32), np.zeros(pad, np.int32)])
+
+    d, counts, _ = _index_block_jit(jnp.asarray(rows), jnp.asarray(ids), cfg)
+
+    # Host assembly: postings prefix + per-word counts -> dict.
+    pairs_keys = np.asarray(jax.device_get(d.keys_bytes()))
+    pairs_vals = np.asarray(jax.device_get(d.values))
+    pairs_valid = np.asarray(jax.device_get(d.valid))
+    word_counts = counts.to_host_pairs()
+
+    out: dict[bytes, list[int]] = {}
+    pos = 0
+    live_vals = pairs_vals[pairs_valid]
+    live_keys = pairs_keys[pairs_valid]
+    for word, cnt in word_counts:
+        out[word] = [int(v) for v in live_vals[pos : pos + cnt]]
+        pos += cnt
+    assert pos == len(live_vals), "postings/count bookkeeping diverged"
+    del live_keys
+    return out

@@ -1,0 +1,126 @@
+"""PageRank: iterative MapReduce over an edge list (BASELINE.json configs[3]).
+
+MapReduce formulation (the reference engine never shipped a second workload,
+but its map/emit/reduce contract extends directly — SURVEY.md §7.1 "API"):
+per iteration, map each edge (s -> d) to the emit ``(d, rank[s]/deg[s])``
+and reduce by key with sum; then apply damping.
+
+TPU-native formulation: node ids ARE the keys, so the shuffle degenerates to
+a dense ``segment_sum`` into a ``[num_nodes]`` vector — no byte keys, no
+sort.  Iterations run under ``lax.scan`` (static trip count) or a
+``while_loop`` on the L1 residual.  Distributed: edges shard across the
+mesh, each device computes a partial dense contribution vector, and the
+"shuffle" is a single ``psum`` — the degenerate all-to-all for dense integer
+keys.  Dangling mass (deg==0 nodes) redistributes uniformly, the standard
+correction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from locust_tpu.parallel.mesh import DATA_AXIS
+
+
+def _contributions(src, dst, ranks, inv_deg, num_nodes):
+    """Dense map+reduce of one iteration: sum_d rank[s]/deg[s]."""
+    contrib = ranks[src] * inv_deg[src]
+    return jax.ops.segment_sum(contrib, dst, num_segments=num_nodes)
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "num_iters"))
+def pagerank(
+    src: jax.Array,
+    dst: jax.Array,
+    num_nodes: int,
+    num_iters: int = 20,
+    damping: float = 0.85,
+) -> jax.Array:
+    """Single-device PageRank over int32 edge arrays ``[E]``.
+
+    Pass valid edges only (no padding); the distributed variant supports
+    masked edge padding for equal shard sizes.
+    """
+    deg = jax.ops.segment_sum(
+        jnp.ones_like(src, dtype=jnp.float32), src, num_segments=num_nodes
+    )
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+    dangling = deg == 0
+    ranks0 = jnp.full((num_nodes,), 1.0 / num_nodes, dtype=jnp.float32)
+
+    def body(ranks, _):
+        contrib = _contributions(src, dst, ranks, inv_deg, num_nodes)
+        dangling_mass = jnp.sum(jnp.where(dangling, ranks, 0.0))
+        ranks_new = (1.0 - damping) / num_nodes + damping * (
+            contrib + dangling_mass / num_nodes
+        )
+        return ranks_new, None
+
+    ranks, _ = jax.lax.scan(body, ranks0, None, length=num_iters)
+    return ranks
+
+
+class DistributedPageRank:
+    """Edge-sharded PageRank on a mesh: local segment_sum + psum combine.
+
+    The mesh/axis contract matches DistributedMapReduce; ranks and degrees
+    are replicated (dense [num_nodes] vectors), edges shard along the axis.
+    Edge padding: pad with (-1 -> clamped) masked edges via ``edge_mask``.
+    """
+
+    def __init__(self, mesh, num_nodes: int, axis_name: str = DATA_AXIS,
+                 damping: float = 0.85):
+        self.mesh = mesh
+        self.num_nodes = num_nodes
+        self.axis = axis_name
+        self.damping = damping
+        n_dev = mesh.shape[axis_name]
+        num = num_nodes
+        damp = damping
+
+        def step(src, dst, mask, ranks, inv_deg, dangling_vec):
+            # Local partial: masked edges contribute 0.
+            w = ranks[src] * inv_deg[src] * mask
+            partial = jax.ops.segment_sum(w, dst, num_segments=num)
+            contrib = jax.lax.psum(partial, axis_name)          # the combine
+            local_dangling = jnp.sum(jnp.where(dangling_vec, ranks, 0.0))
+            ranks_new = (1.0 - damp) / num + damp * (
+                contrib + local_dangling / num
+            )
+            return ranks_new
+
+        self._step = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(axis_name), P(axis_name), P(axis_name), P(), P(), P()),
+                out_specs=P(),
+            )
+        )
+        self.n_dev = n_dev
+
+    def run(self, src: np.ndarray, dst: np.ndarray, num_iters: int = 20) -> np.ndarray:
+        num = self.num_nodes
+        deg = np.bincount(src, minlength=num).astype(np.float32)
+        inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1.0), 0.0).astype(
+            np.float32
+        )
+        dangling = deg == 0
+        # Pad edge shards to equal length per device.
+        e = len(src)
+        per = -(-e // self.n_dev)
+        pad = per * self.n_dev - e
+        src_p = np.concatenate([src, np.zeros(pad, src.dtype)]).astype(np.int32)
+        dst_p = np.concatenate([dst, np.zeros(pad, dst.dtype)]).astype(np.int32)
+        mask = np.concatenate(
+            [np.ones(e, np.float32), np.zeros(pad, np.float32)]
+        )
+        ranks = np.full((num,), 1.0 / num, dtype=np.float32)
+        for _ in range(num_iters):
+            ranks = self._step(src_p, dst_p, mask, ranks, inv_deg, dangling)
+        return np.asarray(jax.device_get(ranks))

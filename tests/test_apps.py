@@ -1,0 +1,101 @@
+"""PageRank + inverted index vs NumPy/pure-Python oracles."""
+
+import numpy as np
+import jax
+import pytest
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.apps import build_inverted_index, pagerank
+from locust_tpu.apps.pagerank import DistributedPageRank
+from locust_tpu.parallel import make_mesh
+
+from helpers import strtok_tokens
+
+
+def np_pagerank(src, dst, n, iters=20, d=0.85):
+    deg = np.bincount(src, minlength=n).astype(np.float64)
+    ranks = np.full(n, 1.0 / n)
+    for _ in range(iters):
+        contrib = np.zeros(n)
+        w = ranks[src] / deg[src]
+        np.add.at(contrib, dst, w)
+        dangling = ranks[deg == 0].sum()
+        ranks = (1 - d) / n + d * (contrib + dangling / n)
+    return ranks
+
+
+EDGES = np.array(
+    [[0, 1], [0, 2], [1, 2], [2, 0], [3, 2], [4, 3], [4, 1], [5, 5]], np.int32
+)
+
+
+def test_pagerank_matches_numpy():
+    src, dst = EDGES[:, 0], EDGES[:, 1]
+    n = 7  # node 6 is dangling (no out-edges)
+    got = np.asarray(pagerank(src, dst, num_nodes=n, num_iters=30))
+    expect = np_pagerank(src, dst, n, iters=30)
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+    np.testing.assert_allclose(got.sum(), 1.0, rtol=1e-5)
+
+
+def test_pagerank_ranking_sane():
+    # Node 2 has the most in-links in EDGES; it should outrank leaf nodes.
+    src, dst = EDGES[:, 0], EDGES[:, 1]
+    r = np.asarray(pagerank(src, dst, num_nodes=7, num_iters=30))
+    assert r[2] == max(r)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_distributed_pagerank_matches_single():
+    src, dst = EDGES[:, 0], EDGES[:, 1]
+    n = 7
+    mesh = make_mesh(8)
+    dpr = DistributedPageRank(mesh, num_nodes=n)
+    got = dpr.run(src, dst, num_iters=30)
+    expect = np_pagerank(src, dst, n, iters=30)
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+DOCS = {
+    0: b"the quick brown fox",
+    1: b"the lazy dog",
+    2: b"quick quick dog",
+    3: b"",
+}
+
+
+def py_inverted_index(docs):
+    out = {}
+    for doc_id, text in docs.items():
+        for w in strtok_tokens(text):
+            out.setdefault(w, set()).add(doc_id)
+    return {w: sorted(ids) for w, ids in out.items()}
+
+
+def test_inverted_index_matches_oracle():
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    lines = list(DOCS.values())
+    ids = np.asarray(list(DOCS.keys()), np.int32)
+    got = build_inverted_index(lines, ids, cfg)
+    assert got == py_inverted_index(DOCS)
+
+
+def test_inverted_index_dedups_repeats():
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    got = build_inverted_index([b"a a a a", b"a a"], np.asarray([7, 9]), cfg)
+    assert got == {b"a": [7, 9]}
+
+
+def test_inverted_index_multiline_doc():
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    # Two lines of the same doc: postings dedup across lines.
+    got = build_inverted_index(
+        [b"x y", b"y z"], np.asarray([5, 5]), cfg
+    )
+    assert got == {b"x": [5], b"y": [5], b"z": [5]}
+
+
+def test_inverted_index_rejects_oversize():
+    cfg = EngineConfig(block_lines=2, line_width=64, emits_per_line=4)
+    with pytest.raises(ValueError, match="exceed block capacity"):
+        build_inverted_index([b"a", b"b", b"c"], np.arange(3), cfg)
