@@ -1,0 +1,1 @@
+from locust_tpu.distributor import master, protocol, worker  # noqa: F401

@@ -1,0 +1,122 @@
+"""Distributor master: the launcher the reference documents but never shipped.
+
+The reference README promises "the provided bash script will launch the
+MapReduce program for all nodes" over a cluster file of ``ip port`` lines
+(reference README.md:18-24) — no such script exists in the repo
+(SURVEY.md C12).  This module implements that role:
+
+  1. parse the cluster file (protocol.parse_cluster_file),
+  2. shard the input by line ranges — the reference's per-node
+     ``[line_start, line_end)`` CLI contract (main.cu:369-374),
+  3. fan the staged map out to all workers in parallel,
+  4. collect each node's intermediate TSV over the authenticated channel
+     (the transport step missing from the reference, SURVEY.md §3.2),
+  5. run the reduce stage locally over all collected TSVs — which re-sorts,
+     fixing the reference's unsorted-reduce-input bug (Q6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import concurrent.futures
+import os
+import socket
+import sys
+import tempfile
+
+from locust_tpu.distributor import protocol
+
+
+class MasterError(RuntimeError):
+    pass
+
+
+def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.0) -> dict:
+    with socket.create_connection(node, timeout=timeout) as sock:
+        protocol.send_frame(sock, req, secret)
+        return protocol.recv_frame(sock, secret)
+
+
+def count_lines(path: str) -> int:
+    from locust_tpu.io import loader
+
+    return len(loader.load_lines(path))
+
+
+def run_job(
+    cluster: list[tuple[str, int]],
+    input_file: str,
+    secret: bytes,
+    workdir: str | None = None,
+    extra_args: list[str] | None = None,
+    rpc=_rpc,
+) -> list[str]:
+    """Fan out map stages, collect TSVs; returns local TSV paths for reduce."""
+    n = len(cluster)
+    total = count_lines(input_file)
+    per = -(-total // n) if total else 1
+    workdir = workdir or tempfile.mkdtemp(prefix="locust_master_")
+    os.makedirs(workdir, exist_ok=True)
+
+    def one(i_node):
+        i, node = i_node
+        start, end = i * per, min((i + 1) * per, total)
+        inter = f"/tmp/locust_node{i}.tsv"
+        resp = rpc(
+            node,
+            {
+                "cmd": "map",
+                "file": input_file,
+                "line_start": start,
+                "line_end": end,
+                "node_num": i,
+                "intermediate": inter,
+                "extra_args": extra_args or [],
+            },
+            secret,
+        )
+        if resp.get("status") != "ok":
+            raise MasterError(
+                f"map failed on node {node}: rc={resp.get('returncode')} "
+                f"err={resp.get('error', '')}\n{resp.get('log', '')}"
+            )
+        fetched = rpc(node, {"cmd": "fetch", "path": inter, "workdir": "/tmp"}, secret)
+        if fetched.get("status") != "ok":
+            raise MasterError(f"fetch failed on node {node}: {fetched.get('error')}")
+        local = os.path.join(workdir, f"node{i}.tsv")
+        with open(local, "wb") as f:
+            f.write(base64.b64decode(fetched["data_b64"]))
+        return local
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
+        return list(ex.map(one, enumerate(cluster)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="locust-master")
+    p.add_argument("cluster_file", help="lines of 'ip port' (reference README.md:18-22)")
+    p.add_argument("input_file")
+    p.add_argument("--secret-env", default="LOCUST_SECRET")
+    p.add_argument("--workdir", default=None)
+    args, passthrough = p.parse_known_args(argv)
+    secret = os.environ.get(args.secret_env, "").encode()
+    if not secret:
+        print(f"error: set ${args.secret_env}", file=sys.stderr)
+        return 2
+    cluster = protocol.parse_cluster_file(args.cluster_file)
+    print(f"[master] {len(cluster)} worker(s)", file=sys.stderr)
+    tsvs = run_job(cluster, args.input_file, secret,
+                   workdir=args.workdir, extra_args=passthrough)
+
+    # Local reduce over all collected TSVs (stage 2; re-sorts — Q6 fix).
+    from locust_tpu import cli
+
+    reduce_args = [args.input_file, "-1", "-1", "0", "2"]
+    for t in tsvs:
+        reduce_args += ["-i", t]
+    return cli.main(reduce_args + passthrough)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""Wire protocol for the distributor control plane.
+
+The reference's protocol is: send a whitespace-separated string, the slave
+executes ``cmd[1:]`` as an arbitrary local command and replies "ACK"
+(reference Distributor/slave.py:16-32) — unauthenticated remote code
+execution (SURVEY.md Q8).  Replaced with:
+
+  * length-prefixed JSON frames (no recv(1024) truncation — slave.py:16
+    silently cuts long commands),
+  * HMAC-SHA256 request authentication over a shared secret,
+  * a closed command whitelist (no shell),
+  * structured replies carrying the subprocess exit status (the reference
+    ACKs unconditionally and discards the return code — slave.py:19-20,32).
+
+This is the CONTROL plane only.  In the TPU framework the data plane is the
+mesh all-to-all (parallel/shuffle.py); the distributor exists for CLI-stage
+parity — fan out staged map runs, collect intermediate TSVs, reduce — i.e.
+the role of the master script the reference documents but never shipped
+(reference README.md:24, SURVEY.md C12).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import socket
+import struct
+
+MAX_FRAME = 64 * 1024 * 1024  # intermediate TSVs ride this channel
+
+COMMANDS = ("ping", "map", "fetch", "shutdown")
+
+
+def _mac(secret: bytes, payload: bytes) -> str:
+    return hmac.new(secret, payload, hashlib.sha256).hexdigest()
+
+
+def send_frame(sock: socket.socket, obj: dict, secret: bytes) -> None:
+    payload = json.dumps(obj, sort_keys=True).encode()
+    frame = json.dumps({"mac": _mac(secret, payload)}).encode() + b"\n" + payload
+    sock.sendall(struct.pack("!I", len(frame)) + frame)
+
+
+def recv_frame(sock: socket.socket, secret: bytes) -> dict:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack("!I", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    frame = _recv_exact(sock, length)
+    mac_line, _, payload = frame.partition(b"\n")
+    try:
+        mac = json.loads(mac_line)["mac"]
+    except (ValueError, TypeError, KeyError):
+        raise PermissionError("malformed auth header — rejecting frame")
+    if not isinstance(mac, str) or not hmac.compare_digest(
+        mac, _mac(secret, payload)
+    ):
+        raise PermissionError("bad HMAC — rejecting frame")
+    return json.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()  # linear-time accumulation (frames can be ~64MB TSVs)
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def parse_cluster_file(path: str) -> list[tuple[str, int]]:
+    """Parse the reference's documented ``ip_address port`` cluster file
+    (reference README.md:18-22) — the parser it never shipped (C12)."""
+    nodes = []
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"bad cluster line (want 'ip port'): {raw!r}")
+            nodes.append((parts[0], int(parts[1])))
+    if not nodes:
+        raise ValueError(f"cluster file {path!r} has no nodes")
+    return nodes

@@ -1,0 +1,150 @@
+"""Distributor worker: the hardened descendant of Distributor/slave.py.
+
+Same topology as the reference slave — a TCP daemon on each node that runs
+the staged MapReduce binary on command (reference Distributor/slave.py:1-38)
+— with the Q8 fixes: HMAC-authenticated frames, a closed command set
+(``ping``/``map``/``fetch``/``shutdown``) instead of arbitrary
+``subprocess.call(cmd[1:])`` (slave.py:30-32), structured status replies
+instead of the unconditional "ACK" (slave.py:19-20), and the subprocess
+exit code actually propagated (the reference discards it, slave.py:32).
+
+``fetch`` is the piece of the data plane the reference left out entirely:
+it returns the node's intermediate TSV so the master can stage it to the
+reduce node (SURVEY.md §3.2 "unspecified transport, missing from repo").
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+from locust_tpu.distributor import protocol
+
+
+def default_map_runner(req: dict) -> dict:
+    """Run the staged map via the CLI in a subprocess (one JAX process/node)."""
+    out = req.get("intermediate", "/tmp/out.txt")
+    cmd = [
+        sys.executable,
+        "-m",
+        "locust_tpu",
+        req["file"],
+        str(req.get("line_start", -1)),
+        str(req.get("line_end", -1)),
+        str(req.get("node_num", 0)),
+        "1",
+        "-i",
+        out,
+    ] + [str(a) for a in req.get("extra_args", [])]
+    proc = subprocess.run(cmd, capture_output=True, timeout=req.get("timeout", 1800))
+    return {
+        "status": "ok" if proc.returncode == 0 else "error",
+        "returncode": proc.returncode,
+        "log": proc.stderr.decode(errors="replace")[-4000:],
+        "intermediate": out,
+    }
+
+
+class Worker:
+    """One worker daemon.  ``map_runner`` is injectable for loopback tests."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: bytes = b"",
+        map_runner=default_map_runner,
+    ):
+        if not secret:
+            raise ValueError("worker requires a shared secret (Q8: no open RCE)")
+        self.secret = secret
+        self.map_runner = map_runner
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(5)
+        self.addr = self._sock.getsockname()
+        self._shutdown = threading.Event()
+
+    def serve_forever(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._sock.settimeout(0.5)
+                conn, peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with conn:
+                try:
+                    req = protocol.recv_frame(conn, self.secret)
+                    resp = self._handle(req)
+                except PermissionError:
+                    continue  # unauthenticated peer: drop silently
+                except Exception as e:
+                    # A malformed frame must never kill the daemon (that
+                    # would be an unauthenticated remote DoS).
+                    resp = {"status": "error", "error": str(e)}
+                try:
+                    protocol.send_frame(conn, resp, self.secret)
+                except OSError:
+                    pass
+        self._sock.close()
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd not in protocol.COMMANDS:
+            return {"status": "error", "error": f"unknown command {cmd!r}"}
+        if cmd == "ping":
+            return {"status": "ok", "pong": True}
+        if cmd == "shutdown":
+            self._shutdown.set()
+            return {"status": "ok", "bye": True}
+        if cmd == "map":
+            try:
+                return self.map_runner(req)
+            except Exception as e:  # propagate failure, don't fake-ACK
+                return {"status": "error", "error": repr(e)}
+        # fetch: stream back an intermediate file this worker produced.
+        path = req.get("path", "")
+        allowed_dir = os.path.realpath(req.get("workdir", "/tmp"))
+        real = os.path.realpath(path)
+        if not real.startswith(allowed_dir + os.sep):
+            return {"status": "error", "error": "path outside workdir"}
+        try:
+            data = open(real, "rb").read()
+        except OSError as e:
+            return {"status": "error", "error": str(e)}
+        return {"status": "ok", "data_b64": base64.b64encode(data).decode()}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="locust-worker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1337)  # reference port, slave.py:7
+    p.add_argument("--secret-env", default="LOCUST_SECRET",
+                   help="env var holding the shared secret")
+    args = p.parse_args(argv)
+    secret = os.environ.get(args.secret_env, "").encode()
+    if not secret:
+        print(f"error: set ${args.secret_env} (refusing unauthenticated mode)",
+              file=sys.stderr)
+        return 2
+    w = Worker(args.host, args.port, secret)
+    print(f"[worker] listening on {w.addr[0]}:{w.addr[1]}", file=sys.stderr)
+    w.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,2 @@
+from locust_tpu.utils.checks import checkify_pipeline, validate_batch  # noqa: F401
+from locust_tpu.utils.profiling import SpanTimer, device_trace  # noqa: F401

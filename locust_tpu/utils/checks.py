@@ -1,0 +1,68 @@
+"""Runtime invariant checking (the race-detector/sanitizer analog).
+
+The reference ships no sanitizers and one known sync hazard
+(``__syncthreads`` after divergent early-return, reference
+MapReduce/src/main.cu:162-174, SURVEY.md §5).  XLA removes that bug class;
+what remains worth checking are DATA invariants at stage boundaries.  Two
+tiers:
+
+  * ``checkify_pipeline`` — wrap a jitted pipeline fn with
+    ``jax.experimental.checkify`` so out-of-range/NaN-class errors surface
+    as real errors instead of silent garbage.
+  * ``validate_batch`` — host-side structural asserts for tests/debugging
+    (valid-prefix layout, in-range values, NUL-padded keys).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import checkify
+
+from locust_tpu.core.kv import KVBatch
+
+
+def checkify_pipeline(fn, errors=checkify.user_checks | checkify.index_checks):
+    """Wrap fn so checkify errors are raised on the host after each call."""
+    checked = checkify.checkify(fn, errors=errors)
+
+    def wrapper(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper
+
+
+def validate_batch(batch: KVBatch, expect_sorted: bool = False, expect_compact: bool = False) -> None:
+    """Host-side invariant asserts; raises AssertionError with specifics."""
+    lanes = np.asarray(jax.device_get(batch.key_lanes))
+    valid = np.asarray(jax.device_get(batch.valid))
+    values = np.asarray(jax.device_get(batch.values))
+    assert lanes.ndim == 2 and lanes.dtype == np.uint32, "lanes must be [N, L] uint32"
+    assert valid.shape == (lanes.shape[0],) and valid.dtype == bool
+    assert values.shape == (lanes.shape[0],)
+
+    if expect_compact:
+        # Valid-prefix layout: no valid row after the first invalid one.
+        if valid.any():
+            last_valid = np.max(np.nonzero(valid)[0])
+            assert valid[: last_valid + 1].all(), "valid rows not a prefix"
+    if expect_sorted:
+        live = lanes[valid]
+        # Lexicographic over lanes == row-wise tuple order.
+        for i in range(1, live.shape[0]):
+            a, b = live[i - 1], live[i]
+            assert tuple(a) <= tuple(b), f"rows {i-1},{i} out of order"
+    # Keys must be NUL-padded: no nonzero byte after the first NUL.
+    from locust_tpu.core.packing import unpack_keys
+    import jax.numpy as jnp
+
+    kb = np.asarray(jax.device_get(unpack_keys(jnp.asarray(lanes[valid]))))
+    for r, row in enumerate(kb):
+        nz = np.nonzero(row)[0]
+        if nz.size:
+            first_nul = np.argmax(row == 0) if (row == 0).any() else row.size
+            assert nz.max() < first_nul or first_nul == row.size, (
+                f"row {r} has bytes after NUL (interior NUL key)"
+            )

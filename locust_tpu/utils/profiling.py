@@ -1,0 +1,50 @@
+"""Profiling + stage tracing.
+
+The reference's tracing is three chrono spans printed with a UB printf
+(reference MapReduce/src/main.cu:405-468, SURVEY.md Q7).  TPU equivalent:
+``jax.profiler`` traces (viewable in TensorBoard/XProf) plus wall-clock
+spans that force ``block_until_ready`` at stage edges, preserving the
+three-stage Map/Process/Reduce report format.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture an XLA/TPU profiler trace for everything inside the block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class SpanTimer:
+    """Named wall-clock spans with device sync at the edges."""
+
+    def __init__(self):
+        self.spans_ms: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, *sync_refs):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            for ref in sync_refs:
+                jax.block_until_ready(ref)
+            self.spans_ms[name] = self.spans_ms.get(name, 0.0) + (
+                time.perf_counter() - t0
+            ) * 1e3
+
+    def report(self) -> str:
+        width = max((len(k) for k in self.spans_ms), default=0)
+        return "\n".join(
+            f"{k.ljust(width)}  {v:10.3f} ms" for k, v in self.spans_ms.items()
+        )

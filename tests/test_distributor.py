@@ -1,0 +1,169 @@
+"""Loopback distributor tests — master + workers on 127.0.0.1.
+
+The reference's shipped code could only ever run on loopback anyway
+(hardcoded 127.0.0.1:1337, slave.py:6-7); we make that a real test harness
+(SURVEY.md §4).  Workers run with an injected in-process map runner so the
+test doesn't spawn a fresh JAX process per node.
+"""
+
+import socket
+
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu import cli
+from locust_tpu.distributor import master, protocol
+from locust_tpu.distributor.worker import Worker
+
+SECRET = b"test-secret"
+
+CORPUS = b"""alpha beta gamma
+beta gamma delta
+gamma delta epsilon
+delta epsilon alpha
+epsilon alpha beta
+"""
+
+
+def make_inproc_runner(tmp_path):
+    """Map runner that invokes the CLI in-process (fast: shared JAX runtime)."""
+
+    def runner(req):
+        rc = cli.main(
+            [
+                req["file"],
+                str(req["line_start"]),
+                str(req["line_end"]),
+                str(req["node_num"]),
+                "1",
+                "-i",
+                req["intermediate"],
+                "--block-lines",
+                "8",
+                "--line-width",
+                "64",
+                "--emits-per-line",
+                "8",
+                "--no-timing",
+            ]
+        )
+        return {"status": "ok" if rc == 0 else "error", "returncode": rc,
+                "log": "", "intermediate": req["intermediate"]}
+
+    return runner
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(CORPUS)
+    return str(p)
+
+
+def test_cluster_file_parser(tmp_path):
+    p = tmp_path / "cluster.txt"
+    p.write_text("# comment\n127.0.0.1 4001\n127.0.0.1 4002\n\n")
+    assert protocol.parse_cluster_file(str(p)) == [
+        ("127.0.0.1", 4001),
+        ("127.0.0.1", 4002),
+    ]
+    bad = tmp_path / "bad.txt"
+    bad.write_text("127.0.0.1\n")
+    with pytest.raises(ValueError):
+        protocol.parse_cluster_file(str(bad))
+
+
+def test_worker_requires_secret():
+    with pytest.raises(ValueError):
+        Worker(secret=b"")
+
+
+def test_worker_rejects_bad_mac():
+    w = Worker(secret=SECRET)
+    w.serve_in_thread()
+    try:
+        with socket.create_connection(w.addr, timeout=5) as s:
+            protocol.send_frame(s, {"cmd": "ping"}, b"wrong-secret")
+            s.settimeout(1.0)
+            with pytest.raises((ConnectionError, socket.timeout, OSError)):
+                protocol.recv_frame(s, b"wrong-secret")
+    finally:
+        _shutdown(w)
+
+
+def test_worker_ping_and_unknown_command():
+    w = Worker(secret=SECRET)
+    w.serve_in_thread()
+    try:
+        assert master._rpc(w.addr, {"cmd": "ping"}, SECRET)["pong"] is True
+        resp = master._rpc(w.addr, {"cmd": "rm -rf /"}, SECRET)
+        assert resp["status"] == "error"  # Q8: no arbitrary commands
+    finally:
+        _shutdown(w)
+
+
+def test_worker_survives_malformed_frames():
+    """Regression: garbage frames must not kill the daemon (remote DoS)."""
+    import struct
+
+    w = Worker(secret=SECRET)
+    w.serve_in_thread()
+    try:
+        for garbage in [b"\x00\x00\x00\x03abc", b"\x00\x00\x00\x10[1]\nnot-json-at-all"]:
+            with socket.create_connection(w.addr, timeout=5) as s:
+                s.sendall(garbage)
+        # Daemon must still answer an authenticated ping afterwards.
+        assert master._rpc(w.addr, {"cmd": "ping"}, SECRET)["pong"] is True
+    finally:
+        _shutdown(w)
+
+
+def test_worker_fetch_path_containment(tmp_path):
+    w = Worker(secret=SECRET)
+    w.serve_in_thread()
+    try:
+        resp = master._rpc(
+            w.addr, {"cmd": "fetch", "path": "/etc/passwd", "workdir": "/tmp"}, SECRET
+        )
+        assert resp["status"] == "error" and "outside" in resp["error"]
+    finally:
+        _shutdown(w)
+
+
+def test_master_end_to_end_loopback(corpus_file, tmp_path, capsysbinary):
+    """Two workers, sharded map, fetch, local reduce — the full missing-master
+    flow of SURVEY.md §3.2-3.3 on loopback."""
+    runner = make_inproc_runner(tmp_path)
+    w1 = Worker(secret=SECRET, map_runner=runner)
+    w2 = Worker(secret=SECRET, map_runner=runner)
+    w1.serve_in_thread()
+    w2.serve_in_thread()
+    try:
+        tsvs = master.run_job(
+            [w1.addr, w2.addr], corpus_file, SECRET, workdir=str(tmp_path / "m")
+        )
+        assert len(tsvs) == 2
+        capsysbinary.readouterr()
+        rc = cli.main(
+            [corpus_file, "-1", "-1", "0", "2", "--block-lines", "8",
+             "--line-width", "64", "--emits-per-line", "8"]
+            + sum((["-i", t] for t in tsvs), [])
+        )
+        assert rc == 0
+        out = capsysbinary.readouterr().out
+        got = {}
+        for line in out.splitlines():
+            k, _, v = line.partition(b"\t")
+            got[k] = int(v)
+        assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+    finally:
+        _shutdown(w1)
+        _shutdown(w2)
+
+
+def _shutdown(w: Worker):
+    try:
+        master._rpc(w.addr, {"cmd": "shutdown"}, SECRET, timeout=5)
+    except Exception:
+        pass
