@@ -24,6 +24,7 @@ import os
 import socket
 import sys
 import tempfile
+import uuid
 
 from locust_tpu.distributor import protocol
 
@@ -39,9 +40,16 @@ def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.
 
 
 def count_lines(path: str) -> int:
-    from locust_tpu.io import loader
-
-    return len(loader.load_lines(path))
+    """Streaming line count (O(1) memory; multi-GB corpora are fine)."""
+    n = 0
+    last = b"\n"
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            n += chunk.count(b"\n")
+            last = chunk[-1:]
+    if last != b"\n":
+        n += 1  # trailing fragment counts (Q1 semantics)
+    return n
 
 
 def run_job(
@@ -58,11 +66,14 @@ def run_job(
     per = -(-total // n) if total else 1
     workdir = workdir or tempfile.mkdtemp(prefix="locust_master_")
     os.makedirs(workdir, exist_ok=True)
+    # Unique per-job intermediate names: concurrent jobs against the same
+    # worker pool must not clobber each other's TSVs.
+    job_id = uuid.uuid4().hex[:12]
 
     def one(i_node):
         i, node = i_node
         start, end = i * per, min((i + 1) * per, total)
-        inter = f"/tmp/locust_node{i}.tsv"
+        inter = f"/tmp/locust_{job_id}_node{i}.tsv"
         resp = rpc(
             node,
             {
@@ -81,7 +92,7 @@ def run_job(
                 f"map failed on node {node}: rc={resp.get('returncode')} "
                 f"err={resp.get('error', '')}\n{resp.get('log', '')}"
             )
-        fetched = rpc(node, {"cmd": "fetch", "path": inter, "workdir": "/tmp"}, secret)
+        fetched = rpc(node, {"cmd": "fetch", "path": inter}, secret)
         if fetched.get("status") != "ok":
             raise MasterError(f"fetch failed on node {node}: {fetched.get('error')}")
         local = os.path.join(workdir, f"node{i}.tsv")

@@ -24,21 +24,42 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import os
 import socket
 import struct
+import time
 
 MAX_FRAME = 64 * 1024 * 1024  # intermediate TSVs ride this channel
 
 COMMANDS = ("ping", "map", "fetch", "shutdown")
+
+# Replay window: frames older than this are rejected; nonces are remembered
+# for at least this long (worker side).
+REPLAY_WINDOW_SECS = 120.0
 
 
 def _mac(secret: bytes, payload: bytes) -> str:
     return hmac.new(secret, payload, hashlib.sha256).hexdigest()
 
 
-def send_frame(sock: socket.socket, obj: dict, secret: bytes) -> None:
+def send_frame(
+    sock: socket.socket, obj: dict, secret: bytes, sign_fresh: bool = True
+) -> None:
+    """Send one authenticated frame.
+
+    ``sign_fresh`` stamps a timestamp + random nonce under the MAC so a
+    recorded frame cannot be replayed later (requests); replies ride the
+    same connection and skip the stamp.
+    """
+    if sign_fresh:
+        obj = dict(obj, _ts=time.time(), _nonce=os.urandom(12).hex())
     payload = json.dumps(obj, sort_keys=True).encode()
     frame = json.dumps({"mac": _mac(secret, payload)}).encode() + b"\n" + payload
+    if len(frame) + 4 > MAX_FRAME:
+        raise ValueError(
+            f"frame of {len(frame)} bytes exceeds MAX_FRAME={MAX_FRAME}; "
+            "chunk the transfer"
+        )
     sock.sendall(struct.pack("!I", len(frame)) + frame)
 
 
@@ -68,6 +89,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed mid-frame")
         buf.extend(chunk)
     return bytes(buf)
+
+
+class ReplayGuard:
+    """Worker-side freshness check: bounded-age timestamps + one-shot nonces."""
+
+    def __init__(self, window: float = REPLAY_WINDOW_SECS):
+        self.window = window
+        self._seen: dict[str, float] = {}
+
+    def check(self, req: dict) -> None:
+        now = time.time()
+        ts = req.get("_ts")
+        nonce = req.get("_nonce")
+        if not isinstance(ts, (int, float)) or not isinstance(nonce, str):
+            raise PermissionError("missing freshness stamp — rejecting frame")
+        if abs(now - ts) > self.window:
+            raise PermissionError("stale frame — rejecting (possible replay)")
+        # Prune expired nonces, then enforce one-shot use.
+        for n, t in list(self._seen.items()):
+            if now - t > self.window:
+                del self._seen[n]
+        if nonce in self._seen:
+            raise PermissionError("nonce reuse — rejecting replayed frame")
+        self._seen[nonce] = now
 
 
 def parse_cluster_file(path: str) -> list[tuple[str, int]]:

@@ -59,11 +59,18 @@ class Worker:
         port: int = 0,
         secret: bytes = b"",
         map_runner=default_map_runner,
+        workdir: str = "/tmp",
+        conn_timeout: float = 30.0,
     ):
         if not secret:
             raise ValueError("worker requires a shared secret (Q8: no open RCE)")
         self.secret = secret
         self.map_runner = map_runner
+        # Fetch containment boundary is WORKER-side configuration; a request
+        # must not be able to choose its own boundary.
+        self.workdir = os.path.realpath(workdir)
+        self.conn_timeout = conn_timeout
+        self._replay_guard = protocol.ReplayGuard()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -82,16 +89,20 @@ class Worker:
                 break
             with conn:
                 try:
+                    # A silent peer must not hang the daemon: bound the read.
+                    conn.settimeout(self.conn_timeout)
                     req = protocol.recv_frame(conn, self.secret)
+                    self._replay_guard.check(req)
+                    conn.settimeout(None)  # map subprocesses may run long
                     resp = self._handle(req)
                 except PermissionError:
-                    continue  # unauthenticated peer: drop silently
+                    continue  # unauthenticated/replayed peer: drop silently
                 except Exception as e:
                     # A malformed frame must never kill the daemon (that
                     # would be an unauthenticated remote DoS).
                     resp = {"status": "error", "error": str(e)}
                 try:
-                    protocol.send_frame(conn, resp, self.secret)
+                    protocol.send_frame(conn, resp, self.secret, sign_fresh=False)
                 except OSError:
                     pass
         self._sock.close()
@@ -116,10 +127,10 @@ class Worker:
             except Exception as e:  # propagate failure, don't fake-ACK
                 return {"status": "error", "error": repr(e)}
         # fetch: stream back an intermediate file this worker produced.
+        # Containment boundary = self.workdir (server config, NOT the request).
         path = req.get("path", "")
-        allowed_dir = os.path.realpath(req.get("workdir", "/tmp"))
         real = os.path.realpath(path)
-        if not real.startswith(allowed_dir + os.sep):
+        if not real.startswith(self.workdir + os.sep):
             return {"status": "error", "error": "path outside workdir"}
         try:
             data = open(real, "rb").read()

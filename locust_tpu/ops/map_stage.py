@@ -80,9 +80,19 @@ def wordcount_map(lines: jax.Array, cfg: EngineConfig) -> tuple[KVBatch, jax.Arr
     Returns the flat emit batch ``[block_lines * emits_per_line]`` and the
     overflow counter — the analog of the reference's per-line fixed-slot emit
     table ``dev_map_kvs[MAX_EMITS]`` (main.cu:20,392).
+
+    ``cfg.use_pallas`` selects the hand-written VMEM-resident kernel
+    (ops/pallas/tokenize.py); interpret mode engages automatically off-TPU.
     """
-    res = tokenize_block(lines, cfg)
-    flat_keys = res.keys.reshape(-1, cfg.key_width)
-    flat_valid = res.valid.reshape(-1)
+    if cfg.use_pallas:
+        from locust_tpu.ops.pallas.tokenize import tokenize_block_pallas
+
+        interpret = jax.default_backend() != "tpu"
+        keys, valid, overflow = tokenize_block_pallas(lines, cfg, interpret)
+    else:
+        res = tokenize_block(lines, cfg)
+        keys, valid, overflow = res.keys, res.valid, res.overflow
+    flat_keys = keys.reshape(-1, cfg.key_width)
+    flat_valid = valid.reshape(-1)
     values = jnp.ones(flat_keys.shape[0], dtype=jnp.int32)
-    return KVBatch.from_bytes(flat_keys, values, flat_valid), res.overflow
+    return KVBatch.from_bytes(flat_keys, values, flat_valid), overflow

@@ -1,0 +1,1 @@
+from locust_tpu.ops.pallas.tokenize import tokenize_block_pallas  # noqa: F401

@@ -26,7 +26,15 @@ def device_trace(logdir: str):
 
 
 class SpanTimer:
-    """Named wall-clock spans with device sync at the edges."""
+    """Named wall-clock spans, syncing the given refs at span EXIT.
+
+    Semantics: a span measures host time from entry until the passed refs
+    are device-complete.  Entry does NOT sync — if earlier async device
+    work is still in flight, either pass its outputs as ``sync_refs`` of
+    the previous span (as engine.timed_run does per stage) or sync
+    manually before opening the next span; otherwise the straggler's
+    device time is billed to the wrong span.
+    """
 
     def __init__(self):
         self.spans_ms: dict[str, float] = {}

@@ -123,10 +123,38 @@ def test_worker_fetch_path_containment(tmp_path):
     w = Worker(secret=SECRET)
     w.serve_in_thread()
     try:
+        # The request cannot choose its own boundary: workdir is server-side.
         resp = master._rpc(
-            w.addr, {"cmd": "fetch", "path": "/etc/passwd", "workdir": "/tmp"}, SECRET
+            w.addr, {"cmd": "fetch", "path": "/etc/passwd", "workdir": "/"}, SECRET
         )
         assert resp["status"] == "error" and "outside" in resp["error"]
+    finally:
+        _shutdown(w)
+
+
+def test_worker_rejects_replayed_frame():
+    """A recorded frame (same nonce) must be dropped the second time."""
+    import time as _time
+
+    w = Worker(secret=SECRET)
+    w.serve_in_thread()
+    try:
+        frozen = {"cmd": "ping", "_ts": _time.time(), "_nonce": "fixed-nonce-1"}
+        with socket.create_connection(w.addr, timeout=5) as s:
+            protocol.send_frame(s, frozen, SECRET, sign_fresh=False)
+            assert protocol.recv_frame(s, SECRET)["pong"] is True
+        with socket.create_connection(w.addr, timeout=5) as s:
+            protocol.send_frame(s, frozen, SECRET, sign_fresh=False)
+            s.settimeout(1.0)
+            with pytest.raises((ConnectionError, socket.timeout, OSError)):
+                protocol.recv_frame(s, SECRET)
+        # Stale timestamp also rejected.
+        stale = {"cmd": "ping", "_ts": _time.time() - 9999, "_nonce": "n2"}
+        with socket.create_connection(w.addr, timeout=5) as s:
+            protocol.send_frame(s, stale, SECRET, sign_fresh=False)
+            s.settimeout(1.0)
+            with pytest.raises((ConnectionError, socket.timeout, OSError)):
+                protocol.recv_frame(s, SECRET)
     finally:
         _shutdown(w)
 
