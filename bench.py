@@ -25,8 +25,8 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
 import numpy as np
 
 BASELINE_MB_S = 2.2
-TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_BYTES", 8 * 1024 * 1024))
-BLOCK_LINES = int(os.environ.get("LOCUST_BENCH_BLOCK_LINES", 16384))
+TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_BYTES", 32 * 1024 * 1024))
+BLOCK_LINES = int(os.environ.get("LOCUST_BENCH_BLOCK_LINES", 32768))
 
 
 def load_corpus() -> list[bytes]:

@@ -90,7 +90,7 @@ def _salted_fold(lanes: jax.Array, salt_prime: int, pre_mul: int | None) -> jax.
     i = jnp.arange(n_lanes, dtype=jnp.uint32)
     salts = (i + 1) * jnp.uint32(salt_prime)
     x = lanes if pre_mul is None else lanes * jnp.uint32(pre_mul)
-    per_lane = _fmix32(x ^ salts[None, :])
+    per_lane = _fmix32(x ^ salts)  # trailing-dim broadcast: any leading rank
     return _fmix32(jnp.sum(per_lane, axis=-1, dtype=jnp.uint32))
 
 

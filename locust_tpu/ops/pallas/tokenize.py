@@ -48,7 +48,16 @@ def _tokenize_kernel(x_ref, keys_ref, valid_ref, ovf_ref, *, emits, key_w, width
     nxt = jnp.concatenate([in_tok[:, 1:], zeros_col], axis=1)
     starts = in_tok & ~prev
     ends = in_tok & ~nxt
-    tid = jnp.cumsum(starts.astype(jnp.int32), axis=1) - 1  # [T, W]
+    # Inclusive prefix sum along the line, as a statically-unrolled
+    # Hillis-Steele doubling scan: log2(W) shift-adds.  (jnp.cumsum has no
+    # Pallas TPU lowering; this form is plain vector adds.)
+    csum = starts.astype(jnp.int32)
+    shift = 1
+    while shift < width:
+        pad = jnp.zeros((csum.shape[0], shift), dtype=jnp.int32)
+        csum = csum + jnp.concatenate([pad, csum[:, :-shift]], axis=1)
+        shift *= 2
+    tid = csum - 1                                          # [T, W]
     pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)   # [T, W]
 
     ntok = jnp.sum(starts.astype(jnp.int32), axis=1, keepdims=True)  # [T, 1]

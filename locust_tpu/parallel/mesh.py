@@ -52,8 +52,26 @@ def initialize_multihost(
 
 
 def shard_rows(rows: np.ndarray, mesh: jax.sharding.Mesh, axis_name: str = DATA_AXIS):
-    """Place host rows onto the mesh, sharded along the line dimension."""
+    """Place host rows onto the mesh, sharded along the line dimension.
+
+    ``rows`` is the GLOBAL array and must be identical on every process.
+    Single-process: one device_put.  Multi-process (multi-host pods or the
+    multi-process CPU test rig): each process contributes the slice covering
+    its addressable devices via ``jax.make_array_from_process_local_data`` —
+    the JAX-native replacement for the reference's per-node ``[start, end)``
+    line-range CLI contract (main.cu:47-54, README.md:18-24).
+    """
     sharding = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(axis_name)
     )
-    return jax.device_put(rows, sharding)
+    if jax.process_count() == 1:
+        return jax.device_put(rows, sharding)
+    n = rows.shape[0]
+    nproc, pid = jax.process_count(), jax.process_index()
+    if n % nproc != 0:
+        raise ValueError(
+            f"global row count {n} must divide evenly over {nproc} processes"
+        )
+    per = n // nproc
+    local = rows[pid * per : (pid + 1) * per]
+    return jax.make_array_from_process_local_data(sharding, local, rows.shape)

@@ -152,6 +152,9 @@ class DistributedMapReduce:
                 combine,
             )
             # Global scalar stats ride psum — the "final combine" collective.
+            # psum output is identical on every device, so the stats leave
+            # shard_map REPLICATED (out_spec P()): every process can read
+            # them without touching non-addressable shards.
             stats = jnp.stack(
                 [
                     jax.lax.psum(emit_ovf, axis),
@@ -159,7 +162,7 @@ class DistributedMapReduce:
                     jax.lax.psum(distinct, axis),
                 ]
             )
-            return new_acc, stats[None]  # [1, 3] per device
+            return new_acc, stats
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
         self._step = jax.jit(
@@ -167,7 +170,7 @@ class DistributedMapReduce:
                 local_step,
                 mesh=mesh,
                 in_specs=(P(axis), kv_spec),
-                out_specs=(kv_spec, P(axis)),
+                out_specs=(kv_spec, P()),
             )
         )
 
@@ -205,7 +208,7 @@ class DistributedMapReduce:
             acc, stats = self._step(sharded, acc)
             # Overflows accumulate across rounds; distinct is a property of
             # the final merged table, so the last round's value stands.
-            round_stats = jax.device_get(stats)[0]
+            round_stats = jax.device_get(stats)  # replicated: host-local read
             emit_ovf += int(round_stats[0])
             shuf_ovf += int(round_stats[1])
             distinct = int(round_stats[2])
@@ -238,8 +241,18 @@ class DistributedResult:
 
         Shards are hash-partitioned (each internally grouped), so global
         lexicographic order needs this final host-side merge — the analog of
-        the reference's final sorted print (main.cu:473).
+        the reference's final sorted print (main.cu:473).  Multi-process:
+        every process gathers all shards (process_allgather over DCN) and
+        returns the identical full table.
         """
         from locust_tpu.engine import finalize_host_pairs
 
-        return finalize_host_pairs(self.table, self.combine, sort)
+        table = self.table
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            lanes, values, valid = multihost_utils.process_allgather(
+                (table.key_lanes, table.values, table.valid), tiled=True
+            )
+            table = KVBatch(key_lanes=lanes, values=values, valid=valid)
+        return finalize_host_pairs(table, self.combine, sort)

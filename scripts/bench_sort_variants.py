@@ -1,8 +1,12 @@
 """Micro-bench: sort strategies for the Process stage, on the real device.
 
 Compares (per N rows, 8 key lanes):
-  A. current: lax.sort with 9 keys (invalid + lanes) + value payload
-  B. hash64: lax.sort with 3 keys (invalid, h1, h2) + index payload, gather after
+  A. lex:    lax.sort with 9 keys (invalid + lanes) + value payload
+  B. hash64: lax.sort with 3 keys (invalid, h1, h2) + index payload, gather
+             after — using the SHIPPED packing.hash_pair (salted-sum form)
+
+Checksums force full materialization: on remote-TPU links,
+block_until_ready alone does not reliably block.
 """
 
 import os
@@ -14,11 +18,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from locust_tpu.core import packing
+
 N = int(os.environ.get("N", 393216))
 L = 8
 
 rng = np.random.default_rng(0)
-lanes = jnp.asarray(rng.integers(0, 2**32, size=(N, L), dtype=np.uint64).astype(np.uint32))
+lanes = jnp.asarray(
+    rng.integers(0, 2**32, size=(N, L), dtype=np.uint64).astype(np.uint32)
+)
 values = jnp.asarray(rng.integers(0, 100, size=(N,), dtype=np.int32))
 valid = jnp.asarray(rng.random(N) < 0.6)
 
@@ -27,48 +35,26 @@ def variant_a(lanes, values, valid):
     invalid = (~valid).astype(jnp.uint32)
     operands = (invalid, *(lanes[:, i] for i in range(L)), values)
     out = jax.lax.sort(operands, num_keys=1 + L)
-    return out[0], out[1], out[-1]
-
-
-M1 = jnp.uint32(0x85EBCA6B)
-M2 = jnp.uint32(0xC2B2AE35)
-
-
-def _mix(h):
-    h ^= h >> 16
-    h *= M1
-    h ^= h >> 13
-    h *= M2
-    h ^= h >> 16
-    return h
-
-
-def hash2(lanes):
-    h1 = jnp.uint32(0x9E3779B9)
-    h2 = jnp.uint32(0x7F4A7C15)
-    for i in range(L):
-        h1 = _mix(h1 ^ lanes[:, i] if i else h1 ^ lanes[:, i])
-        h2 = _mix((h2 * M1) ^ lanes[:, i])
-    return h1, h2
+    return jnp.sum(out[1]) + jnp.sum(out[-1].astype(jnp.uint32))
 
 
 def variant_b(lanes, values, valid):
     invalid = (~valid).astype(jnp.uint32)
-    h1, h2 = hash2(lanes)
+    h1, h2 = packing.hash_pair(lanes)
     idx = jnp.arange(N, dtype=jnp.int32)
     _, _, _, sidx = jax.lax.sort((invalid, h1, h2, idx), num_keys=3)
-    return lanes[sidx], values[sidx], valid[sidx]
+    return jnp.sum(lanes[sidx, 0]) + jnp.sum(values[sidx].astype(jnp.uint32))
 
 
 def timeit(fn, *args, reps=5):
     f = jax.jit(fn)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(f(*args))
+    float(f(*args))
     compile_s = time.perf_counter() - t0
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(f(*args))
+        float(f(*args))
         best = min(best, time.perf_counter() - t0)
     return compile_s, best * 1e3
 

@@ -1,0 +1,67 @@
+"""Worker entrypoint for the multi-process distributed test.
+
+Each OS process joins the JAX coordination service, contributes 2 virtual
+CPU devices to a 4-device global mesh, and runs the SAME global WordCount;
+process 0 writes the gathered result table as JSON.  This is the standard
+JAX recipe for exercising the multi-host path (coordinator + per-process
+``jax.distributed.initialize`` + ``make_array_from_process_local_data``)
+without a TPU pod — the real-pod launch differs only in addresses
+(SURVEY.md §7.3.5).
+
+Usage: multiprocess_worker.py <coordinator> <num_procs> <pid> <out_json>
+Env (set by the spawning test, BEFORE jax import):
+  JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    coordinator, num_procs, pid, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+
+    import jax
+
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.parallel import DistributedMapReduce, make_mesh
+    from locust_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost(coordinator, num_procs, pid)
+    assert jax.process_count() == num_procs, jax.process_count()
+
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    mesh = make_mesh()  # all devices across all processes
+    dmr = DistributedMapReduce(mesh, cfg)
+
+    # Deterministic corpus, identical on every process.
+    lines = [
+        b"the quick brown fox jumps over the dog",
+        b"pack my box with five dozen liquor jugs",
+        b"the five boxing wizards jump quickly",
+        b"sphinx of black quartz judge my vow",
+    ] * (dmr.lines_per_round // 2)
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    pairs = res.to_host_pairs()
+
+    if pid == 0:
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "pairs": [[k.decode(), v] for k, v in pairs],
+                    "n_devices": len(jax.devices()),
+                    "n_lines": len(lines),
+                },
+                f,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
