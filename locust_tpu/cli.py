@@ -53,6 +53,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-timing", action="store_true")
     p.add_argument("--limit", type=int, default=None,
                    help="print only the first N table rows")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="crash-resumable block-granular snapshots: a re-run "
+                        "with the same corpus+config resumes at the last "
+                        "snapshot (TPU upgrade of the reference's "
+                        "/tmp/out.txt restartability, SURVEY.md §5)")
+    def positive_int(s: str) -> int:
+        v = int(s)
+        if v < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return v
+
+    p.add_argument("--checkpoint-every", type=positive_int, default=8,
+                   help="blocks between snapshots (with --checkpoint-dir)")
     return p
 
 
@@ -88,7 +101,14 @@ def _run(args) -> int:
             args.filename, cfg.line_width, args.line_start, args.line_end
         )
         print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
-        res = eng.timed_run(rows) if not args.no_timing else eng.run_fused(rows)
+        if args.checkpoint_dir:
+            res = eng.run_checkpointed(
+                rows, args.checkpoint_dir, every=args.checkpoint_every
+            )
+        elif args.no_timing:
+            res = eng.run_fused(rows)
+        else:
+            res = eng.timed_run(rows)
         if not args.no_timing:
             # The reference's per-stage report (README.md:72-88 format).
             print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)

@@ -50,19 +50,41 @@ def lanes_equal(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.all(a == b, axis=-1)
 
 
+def _first_diff_lanes(a: jax.Array, b: jax.Array):
+    """Broadcast-compare lane tuples; return (any_diff, a_at, b_at) where
+    ``*_at`` are the values at the first (most significant) differing lane.
+
+    The shared core of every lexicographic comparator here: big-endian lane
+    tuple order == byte order, so the first differing lane decides.
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+    neq = a != b
+    first_diff = jnp.argmax(neq, axis=-1)
+    a_at = jnp.take_along_axis(a, first_diff[..., None], axis=-1)[..., 0]
+    b_at = jnp.take_along_axis(b, first_diff[..., None], axis=-1)[..., 0]
+    return jnp.any(neq, axis=-1), a_at, b_at
+
+
 def lanes_less(a: jax.Array, b: jax.Array) -> jax.Array:
     """Row-wise lexicographic ``a < b`` over big-endian lanes.
 
     Equivalent to KIVComparator (KeyValue.h:20-33) on the unpacked bytes —
     without its walk-past-NUL out-of-bounds read on equal keys (SURVEY.md Q3).
     """
-    # First lane where they differ decides; scan from most significant.
-    neq = a != b
-    first_diff = jnp.argmax(neq, axis=-1)
-    a_at = jnp.take_along_axis(a, first_diff[..., None], axis=-1)[..., 0]
-    b_at = jnp.take_along_axis(b, first_diff[..., None], axis=-1)[..., 0]
-    any_diff = jnp.any(neq, axis=-1)
+    any_diff, a_at, b_at = _first_diff_lanes(a, b)
     return jnp.where(any_diff, a_at < b_at, False)
+
+
+def lanes_geq_table(keys: jax.Array, splitters: jax.Array) -> jax.Array:
+    """Pairwise lexicographic ``keys[n] >= splitters[s]`` -> bool ``[N, S]``.
+
+    Vectorized comparator for range partitioning (sample sort).  S (number
+    of splitters, ~mesh size) is small, so the [N, S, L] broadcast is cheap.
+    """
+    any_diff, a_at, b_at = _first_diff_lanes(
+        keys[:, None, :], splitters[None, :, :]
+    )
+    return jnp.where(any_diff, a_at > b_at, True)           # equal => >=
 
 
 def _fmix32(h: jax.Array) -> jax.Array:

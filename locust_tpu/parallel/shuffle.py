@@ -47,16 +47,21 @@ def _round_up(x: int, m: int) -> int:
 
 
 def partition_to_bins(
-    batch: KVBatch, n_bins: int, bin_capacity: int
+    batch: KVBatch, n_bins: int, bin_capacity: int, bucket: jax.Array | None = None
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Scatter a batch into ``[n_bins, capacity]`` by key hash.
+
+    ``bucket`` overrides the destination-bin assignment (uint32 ``[N]`` in
+    ``[0, n_bins)``) — used by range partitioners (apps/sample_sort.py);
+    default is the hash partition.
 
     Returns (lanes [B,C,L], values [B,C], valid [B,C], overflow []) where
     overflow counts live entries dropped because their bin was full.
     """
     lanes, values, valid = batch.key_lanes, batch.values, batch.valid
     n, n_lanes = lanes.shape
-    bucket = (packing.fold_hash(lanes) % n_bins).astype(jnp.uint32)
+    if bucket is None:
+        bucket = (packing.fold_hash(lanes) % n_bins).astype(jnp.uint32)
     bucket = jnp.where(valid, bucket, n_bins)  # invalid -> sentinel bin
 
     # Group by bin: single-key sort carrying only a row index, then gather.

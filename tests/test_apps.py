@@ -99,3 +99,67 @@ def test_inverted_index_rejects_oversize():
     cfg = EngineConfig(block_lines=2, line_width=64, emits_per_line=4)
     with pytest.raises(ValueError, match="exceed block capacity"):
         build_inverted_index([b"a", b"b", b"c"], np.arange(3), cfg)
+
+
+# ---------------------------------------------------------------- sample sort
+
+def test_distributed_sample_sort_random():
+    from locust_tpu.apps.sample_sort import sort_strings
+    from locust_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(7)
+    words = [
+        bytes(rng.integers(97, 123, size=rng.integers(1, 12)).astype(np.uint8))
+        for _ in range(4000)
+    ]
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    got = sort_strings(words, make_mesh(8), cfg)
+    assert got == sorted(words)
+
+
+def test_distributed_sample_sort_carries_values():
+    from locust_tpu.apps.sample_sort import DistributedSort
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.parallel import make_mesh
+
+    words = [b"delta", b"alpha", b"echo", b"charlie", b"bravo", b"foxtrot"]
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    ds = DistributedSort(make_mesh(8), cfg, rows_per_device=8)
+    rows = bytes_ops.strings_to_rows(words, cfg.key_width)
+    got = ds.sort_rows(rows).to_host_sorted()
+    # values are the original indices: sort is a permutation we can invert
+    assert [k for k, _ in got] == sorted(words)
+    assert [words[v] for _, v in got] == sorted(words)
+    assert ds.sort_rows(rows).overflow == 0
+
+
+def test_distributed_sample_sort_duplicate_heavy():
+    from locust_tpu.apps.sample_sort import sort_strings
+    from locust_tpu.parallel import make_mesh
+
+    words = [b"same"] * 300 + [b"other"] * 200 + [b"zz", b"aa"] * 50
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    got = sort_strings(words, make_mesh(8), cfg, skew_factor=8.0)
+    assert got == sorted(words)
+
+
+def test_distributed_sample_sort_mostly_padding():
+    """Regression: splitters must come from VALID samples only — zero-padding
+    rows once dragged all splitters to zero, funneling every real key into
+    one overflowing bin and silently dropping rows."""
+    from locust_tpu.apps.sample_sort import DistributedSort
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(3)
+    words = [
+        bytes(rng.integers(97, 123, size=rng.integers(1, 12)).astype(np.uint8))
+        for _ in range(1000)
+    ]
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    ds = DistributedSort(make_mesh(8), cfg, rows_per_device=1024)  # 87% padding
+    rows = bytes_ops.strings_to_rows(words, cfg.key_width)
+    res = ds.sort_rows(rows)
+    got = [k for k, _ in res.to_host_sorted()]
+    assert res.overflow == 0
+    assert got == sorted(words)
