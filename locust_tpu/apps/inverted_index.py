@@ -7,8 +7,12 @@ fixed-slot emit contract (SURVEY.md §7.2 M5).
 TPU-native formulation with static shapes throughout:
 
   1. Map: tokenize lines (ops/map_stage), value = the line's doc id.
-  2. Sort by (validity, key, value): ONE multi-operand sort groups words
-     AND orders each word's doc ids — num_keys covers the value too.
+  2. Sort by (validity, hash64(key), value): the 64-bit grouping-hash trick
+     from the Process stage (ops/process_stage "hash" mode) — 4 key
+     operands regardless of key width groups words AND orders each word's
+     doc ids; payload rows follow via one index gather.  Full-key compares
+     drive all downstream boundaries, so hash collisions cannot merge
+     words; host assembly re-merges the ~2^-64 duplicate-run case.
   3. Dedup (word, doc) pairs with a boundary mask on pair equality, then
      one more sort-compact pushes surviving pairs to the prefix.
   4. Word segment boundaries over the deduped prefix give the postings
@@ -23,84 +27,120 @@ import jax.numpy as jnp
 import numpy as np
 
 from locust_tpu.config import EngineConfig
-from locust_tpu.core import bytes_ops
+from locust_tpu.core import bytes_ops, packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import tokenize_block
 from locust_tpu.ops.reduce_stage import segment_reduce
 
 
 def _sort_pairs(batch: KVBatch) -> KVBatch:
-    """Sort by (validity desc, key lex, value asc) — values are sort keys too."""
-    lanes = batch.key_lanes
-    n_lanes = lanes.shape[-1]
-    invalid = (~batch.valid).astype(jnp.uint32)
-    ops = (invalid, *(lanes[:, i] for i in range(n_lanes)), batch.values)
-    out = jax.lax.sort(ops, num_keys=2 + n_lanes)  # value participates
+    """Group by (validity, hash64(key)) with values as a tie-break sort key.
+
+    4 sort operands + an index payload regardless of key width — the hash
+    trick from ops/process_stage._hash_sort, extended with the value as the
+    least-significant key so each word's doc ids come out ascending.
+    """
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    n = lanes.shape[0]
+    invalid = (~valid).astype(jnp.uint32)
+    h1, h2 = packing.hash_pair(lanes)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort((invalid, h1, h2, values, idx), num_keys=4)
+    sidx = out[4]
     return KVBatch(
-        key_lanes=jnp.stack(out[1 : 1 + n_lanes], axis=-1),
-        values=out[1 + n_lanes],
-        valid=out[0] == 0,
+        key_lanes=lanes[sidx], values=values[sidx], valid=valid[sidx]
     )
 
 
-def _index_block(lines: jax.Array, doc_ids: jax.Array, cfg: EngineConfig):
-    """One block -> (word rows, postings doc ids, per-word counts, n_words)."""
-    res = tokenize_block(lines, cfg)
-    flat_keys = res.keys.reshape(-1, cfg.key_width)
-    flat_valid = res.valid.reshape(-1)
-    values = jnp.repeat(doc_ids.astype(jnp.int32), cfg.emits_per_line)
-    batch = KVBatch.from_bytes(flat_keys, values, flat_valid)
-
-    s = _sort_pairs(batch)
+def _dedup_sorted_pairs(s: KVBatch) -> tuple[KVBatch, jax.Array]:
+    """Mark the first of each identical (word, doc) run; return the
+    re-compacted batch and the surviving-pair count."""
     n = s.size
-    # Dedup identical (word, doc) pairs: keep first of each run.
     prev_lanes = jnp.roll(s.key_lanes, 1, axis=0)
     prev_vals = jnp.roll(s.values, 1)
     first = jnp.arange(n) == 0
     pair_new = first | jnp.any(s.key_lanes != prev_lanes, axis=-1) | (
         s.values != prev_vals
     )
-    deduped = KVBatch(
-        key_lanes=s.key_lanes, values=s.values, valid=s.valid & pair_new
-    )
+    keep = s.valid & pair_new
+    deduped = KVBatch(key_lanes=s.key_lanes, values=s.values, valid=keep)
     d = _sort_pairs(deduped)  # compact survivors to the prefix, still ordered
-
-    # Per-word postings counts via segment reduce with combine="count".
-    counts = segment_reduce(d, "count")
-    return d, counts, res.overflow
+    return d, jnp.sum(keep.astype(jnp.int32))
 
 
-# Module-level jit: one compile per (shapes, cfg), shared across calls.
-_index_block_jit = jax.jit(_index_block, static_argnames="cfg")
+def _fold_index_block(
+    acc: KVBatch,
+    lines: jax.Array,
+    doc_ids: jax.Array,
+    cfg: EngineConfig,
+    cap: int,
+):
+    """Merge one block's (word, doc) pairs into the running deduped table.
+
+    Same one-sort-per-block fold as the WordCount engine (engine.py
+    fold_block), but the carried state is the PAIR set, which the final
+    segment count turns into CSR postings.
+    """
+    res = tokenize_block(lines, cfg)
+    flat_keys = res.keys.reshape(-1, cfg.key_width)
+    flat_valid = res.valid.reshape(-1)
+    values = jnp.repeat(doc_ids.astype(jnp.int32), cfg.emits_per_line)
+    batch = KVBatch.from_bytes(flat_keys, values, flat_valid)
+
+    d, n_pairs = _dedup_sorted_pairs(_sort_pairs(KVBatch.concat(acc, batch)))
+    head = KVBatch(
+        key_lanes=d.key_lanes[:cap], values=d.values[:cap], valid=d.valid[:cap]
+    )
+    return head, n_pairs, res.overflow
+
+
+_fold_index_jit = jax.jit(_fold_index_block, static_argnames=("cfg", "cap"))
 
 
 def build_inverted_index(
     lines: list[bytes] | np.ndarray,
     doc_ids: np.ndarray,
     cfg: EngineConfig | None = None,
+    pairs_capacity: int | None = None,
 ) -> dict[bytes, list[int]]:
     """Host API: lines + per-line doc ids -> {word: sorted unique doc ids}.
 
-    Single-block for now (cap: cfg.block_lines lines per call); the engine's
-    merge machinery extends this to streamed corpora the same way WordCount
-    merges block tables.
+    Streams the corpus through fixed-shape blocks like the WordCount engine
+    — no line-count cap.  ``pairs_capacity`` bounds the distinct (word, doc)
+    pair table carried across blocks (default 2x emits_per_block); exceeding
+    it raises, since a truncated index is silently wrong.
     """
     cfg = cfg or EngineConfig()
+    cap = pairs_capacity or 2 * cfg.emits_per_block
     if not isinstance(lines, np.ndarray):
         rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
     else:
         rows = lines
-    n = rows.shape[0]
-    if n > cfg.block_lines:
-        raise ValueError(
-            f"{n} lines exceed block capacity {cfg.block_lines}; "
-            "raise cfg.block_lines or chunk the corpus"
-        )
-    pad = cfg.block_lines - n
-    rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
-    ids = np.concatenate([np.asarray(doc_ids, np.int32), np.zeros(pad, np.int32)])
+    ids = np.asarray(doc_ids, np.int32)
+    if rows.shape[0] != ids.shape[0]:
+        raise ValueError(f"{rows.shape[0]} lines but {ids.shape[0]} doc ids")
 
-    d, counts, _ = _index_block_jit(jnp.asarray(rows), jnp.asarray(ids), cfg)
+    bl = cfg.block_lines
+    nblocks = max(1, -(-rows.shape[0] // bl))
+    pad = nblocks * bl - rows.shape[0]
+    rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
+    ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+
+    acc = KVBatch.empty(cap, cfg.key_lanes)
+    n_pairs = 0
+    for b in range(nblocks):
+        sl = slice(b * bl, (b + 1) * bl)
+        acc, blk_pairs, _ = _fold_index_jit(
+            acc, jnp.asarray(rows[sl]), jnp.asarray(ids[sl]), cfg, cap
+        )
+        n_pairs = max(n_pairs, int(blk_pairs))
+    if n_pairs > cap:
+        raise ValueError(
+            f"distinct (word, doc) pairs ({n_pairs}) exceed pairs_capacity "
+            f"({cap}); pass a larger pairs_capacity"
+        )
+    d = acc
+    counts = segment_reduce(d, "count")
 
     # Host assembly: postings prefix + per-word counts -> dict.
     pairs_keys = np.asarray(jax.device_get(d.keys_bytes()))
@@ -111,10 +151,12 @@ def build_inverted_index(
     out: dict[bytes, list[int]] = {}
     pos = 0
     live_vals = pairs_vals[pairs_valid]
-    live_keys = pairs_keys[pairs_valid]
     for word, cnt in word_counts:
-        out[word] = [int(v) for v in live_vals[pos : pos + cnt]]
+        run = [int(v) for v in live_vals[pos : pos + cnt]]
+        if word in out:  # 64-bit hash collision split a word into two runs
+            run = sorted(set(out[word] + run))
+        out[word] = run
         pos += cnt
     assert pos == len(live_vals), "postings/count bookkeeping diverged"
-    del live_keys
+    del pairs_keys
     return out

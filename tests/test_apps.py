@@ -95,10 +95,17 @@ def test_inverted_index_multiline_doc():
     assert got == {b"x": [5], b"y": [5], b"z": [5]}
 
 
-def test_inverted_index_rejects_oversize():
+def test_inverted_index_streams_past_block_capacity():
+    # Corpora larger than one block stream through the fold (no line cap).
     cfg = EngineConfig(block_lines=2, line_width=64, emits_per_line=4)
-    with pytest.raises(ValueError, match="exceed block capacity"):
-        build_inverted_index([b"a", b"b", b"c"], np.arange(3), cfg)
+    got = build_inverted_index([b"a", b"b", b"c"], np.arange(3), cfg)
+    assert got == {b"a": [0], b"b": [1], b"c": [2]}
+
+
+def test_inverted_index_mismatched_doc_ids_raises():
+    cfg = EngineConfig(block_lines=2, line_width=64, emits_per_line=4)
+    with pytest.raises(ValueError, match="doc ids"):
+        build_inverted_index([b"a", b"b"], np.arange(3), cfg)
 
 
 # ---------------------------------------------------------------- sample sort
@@ -163,3 +170,38 @@ def test_distributed_sample_sort_mostly_padding():
     got = [k for k, _ in res.to_host_sorted()]
     assert res.overflow == 0
     assert got == sorted(words)
+
+
+def test_inverted_index_multi_block_streaming():
+    """The index streams blocks like the engine: corpora larger than one
+    block fold into the carried pair table."""
+    from locust_tpu.apps.inverted_index import build_inverted_index
+
+    docs = [
+        (0, b"alpha bravo charlie"),
+        (1, b"bravo delta"),
+        (2, b"alpha delta echo"),
+        (3, b"charlie charlie alpha"),
+        (4, b"echo foxtrot"),
+        (5, b"bravo alpha"),
+    ] * 4
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=6)
+    got = build_inverted_index(
+        [t for _, t in docs], np.asarray([d for d, _ in docs]), cfg
+    )
+    want: dict[bytes, set] = {}
+    for d, text in docs:
+        for w in text.split():
+            want.setdefault(w, set()).add(d)
+    assert {k: sorted(v) for k, v in want.items()} == got
+
+
+def test_inverted_index_capacity_exceeded_raises():
+    from locust_tpu.apps.inverted_index import build_inverted_index
+
+    lines = [f"w{i} w{i+1} w{i+2}".encode() for i in range(0, 64, 1)]
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=4)
+    with pytest.raises(ValueError, match="pairs_capacity"):
+        build_inverted_index(
+            lines, np.arange(len(lines)), cfg, pairs_capacity=16
+        )
