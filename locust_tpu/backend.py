@@ -1,0 +1,239 @@
+"""Backend selection: resilient TPU init with CPU fallback.
+
+The ambient environment may inject a remote-TPU PJRT plugin ("axon", one
+real chip behind a high-latency tunnel) into every interpreter via
+sitecustomize.  Two failure modes were observed in production:
+
+  * the plugin raises ``UNAVAILABLE: TPU backend setup/compile error``
+    during init (the round-1 bench failure, BENCH_r01.json), or
+  * init *hangs* indefinitely — and because jax initializes ALL registered
+    plugins on first backend use even when ``JAX_PLATFORMS=cpu``, the hang
+    takes down pure-CPU runs too (the round-1 CLI hang).
+
+The reference has no analog (single local GPU, CUDA init either works or
+aborts, reference MapReduce/src/main.cu:393); on a remote-accelerator
+tunnel, resilience is part of the driver's job.  Strategy:
+
+  * Never initialize a possibly-wedged backend in-process first.  Probe it
+    in a SUBPROCESS with a hard timeout and bounded retries; a wedged
+    tunnel kills the child, not us.
+  * CPU mode deregisters the TPU plugin factory *before* first backend
+    use, so a wedged tunnel cannot stall a CPU run.
+
+``select_backend()`` must run before anything touches a jax backend
+(``jax.devices()``, ``jnp.asarray`` on a concrete value, jit execution).
+Plugin *registration* happens at import; *initialization* is lazy — the
+window where deregistration works.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger("locust_tpu")
+
+# jax's own backend factories.  Only THIRD-PARTY PJRT plugins (e.g. the
+# injected remote-TPU tunnel "axon") get deregistered by force_cpu: jax
+# initializes those eagerly even under JAX_PLATFORMS=cpu, whereas the
+# built-ins respect the platform pin — and popping the built-in "tpu"
+# factory breaks later mlir platform registration (checkify import).
+_BUILTIN_FACTORIES = ("cpu", "interpreter", "tpu", "cuda", "rocm", "gpu", "metal")
+
+# Probe results are cached in uid-scoped timestamp markers so back-to-back
+# invocations (CLI runs, distributor workers, bench retries) neither pay a
+# duplicate child-process backend init (tens of seconds on a remote tunnel)
+# nor re-probe a known-down tunnel (minutes of retry budget per run).
+_uid = os.getuid() if hasattr(os, "getuid") else 0
+_PROBE_OK_MARKER = f"/tmp/locust_tpu_probe_ok.{_uid}"
+_PROBE_OK_TTL_S = 300.0
+_PROBE_FAIL_MARKER = f"/tmp/locust_tpu_probe_fail.{_uid}"
+_PROBE_FAIL_TTL_S = 120.0
+
+_PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print('PLATFORM=' + d[0].platform, flush=True)"
+)
+
+
+def force_cpu() -> None:
+    """Pin this process to the XLA CPU backend, immune to a wedged TPU tunnel.
+
+    Deregisters every non-CPU PJRT plugin factory (still possible while
+    backends are uninitialized) and pins ``jax_platforms=cpu``.  Safe to
+    call more than once; a no-op after a backend initialized (jax then
+    keeps whatever it has).
+    """
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        for name in list(xb._backend_factories):
+            if name not in _BUILTIN_FACTORIES:
+                xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # pragma: no cover - defensive: never block a run
+        logger.warning("force_cpu: could not adjust jax backends: %s", e)
+
+
+def _unpin_platforms() -> None:
+    """Clear any CPU pin so a tpu selection actually runs on the accelerator.
+
+    An ambient ``JAX_PLATFORMS=cpu`` (or an earlier ``force_cpu`` config
+    update) would otherwise make the real run silently execute on CPU after
+    a passing probe — the probe child strips the pin, the parent must too.
+    ``None`` restores jax's default plugin-priority resolution.
+    """
+    os.environ.pop("JAX_PLATFORMS", None)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", None)
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("could not unpin jax_platforms: %s", e)
+
+
+def probe_tpu(
+    timeout_s: float = 120.0, retries: int = 3, backoff_s: float = 5.0
+) -> tuple[bool, str]:
+    """Check from a SUBPROCESS whether a non-CPU backend initializes.
+
+    Returns (ok, detail).  ``ok`` is True iff a child process ran
+    ``jax.devices()`` to completion within ``timeout_s`` and the default
+    platform is not CPU.  Retries with linear backoff — the round-1
+    failure (BENCH_r01.json rc=1) was a transient tunnel UNAVAILABLE.
+    """
+    for marker, ttl, ok in (
+        (_PROBE_OK_MARKER, _PROBE_OK_TTL_S, True),
+        (_PROBE_FAIL_MARKER, _PROBE_FAIL_TTL_S, False),
+    ):
+        try:
+            age = time.time() - os.path.getmtime(marker)
+        except OSError:
+            continue
+        if 0 <= age < ttl:
+            word = "up" if ok else "down"
+            return ok, f"cached probe: backend {word} ({age:.0f}s ago)"
+    detail = "no attempts"
+    env = dict(os.environ)
+    # The probe must see the ambient TPU config, not a CPU pin.
+    env.pop("JAX_PLATFORMS", None)
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(backoff_s * attempt)
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            detail = f"attempt {attempt + 1}: init timed out after {timeout_s:.0f}s"
+            logger.warning("probe_tpu: %s", detail)
+            continue
+        dt = time.perf_counter() - t0
+        if proc.returncode == 0 and "PLATFORM=" in proc.stdout:
+            platform = proc.stdout.rsplit("PLATFORM=", 1)[1].strip()
+            if platform != "cpu":
+                _touch(_PROBE_OK_MARKER, platform)
+                return True, f"{platform} backend up ({dt:.1f}s init)"
+            return False, "only the CPU backend is available"
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        detail = f"attempt {attempt + 1}: rc={proc.returncode} {tail[-1] if tail else ''}"
+        logger.warning("probe_tpu: %s", detail)
+    _touch(_PROBE_FAIL_MARKER, detail)
+    return False, detail
+
+
+def _touch(path: str, content: str) -> None:
+    try:
+        with open(path, "w") as f:
+            f.write(content)
+    except OSError:  # pragma: no cover - markers are best-effort
+        pass
+
+
+def _eager_init(timeout_s: float) -> str:
+    """Initialize the jax backend NOW, under a hang-watchdog.
+
+    The probe is a different process: this process's own init can still
+    hang if the tunnel wedges in between (or a cached ok-marker was
+    trusted).  A hang here would otherwise be unbounded — the round-1 CLI
+    failure mode — so a daemon timer turns it into a bounded, explained
+    exit.  With ``jax_platforms`` unpinned (None), a plugin that fails
+    FAST is skipped by jax's default resolution and this returns "cpu"
+    instead of raising — callers decide whether that's acceptable.
+    """
+    done = threading.Event()
+
+    def watch() -> None:
+        if not done.wait(timeout_s):
+            print(
+                f"locust_tpu: backend init exceeded {timeout_s:.0f}s "
+                "(wedged TPU tunnel?); aborting. Re-run with backend=cpu.",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    finally:
+        done.set()
+    return platform
+
+
+def select_backend(
+    mode: str = "auto",
+    probe_timeout_s: float = 120.0,
+    retries: int = 3,
+    init_timeout_s: float | None = None,
+) -> str:
+    """Resolve AND initialize the backend for this process: "cpu" or "tpu".
+
+    Modes:
+      * ``cpu``  — pin CPU, deregister the TPU plugin (never hangs).
+      * ``tpu``  — require an accelerator; RuntimeError if the probe fails
+        or this process's own init lands on CPU anyway.
+      * ``auto`` — accelerator if the probe passes, else CPU fallback.
+
+    An ambient ``JAX_PLATFORMS=cpu`` forces CPU in auto mode: that env var
+    is the user's explicit ask and round 1 showed it must actually work
+    when the tunnel is down (VERDICT.md weak #1).
+
+    On a tpu selection the backend is initialized HERE, under a watchdog
+    (``init_timeout_s``, default ``probe_timeout_s + 60``) that exits the
+    process rather than hanging forever if the tunnel wedged after the
+    probe (or a cached probe marker was trusted).
+    """
+    if mode not in ("auto", "cpu", "tpu"):
+        raise ValueError(f"backend mode must be auto|cpu|tpu, got {mode!r}")
+    if mode == "cpu" or (
+        mode == "auto" and os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    ):
+        force_cpu()
+        return "cpu"
+
+    ok, detail = probe_tpu(timeout_s=probe_timeout_s, retries=retries)
+    if ok:
+        logger.info("select_backend: %s", detail)
+        _unpin_platforms()
+        platform = _eager_init(init_timeout_s or probe_timeout_s + 60)
+        if platform != "cpu":
+            return "tpu"
+        detail = "probe passed but this process's init landed on CPU"
+    if mode == "tpu":
+        raise RuntimeError(f"TPU backend required but unavailable: {detail}")
+    logger.warning("select_backend: falling back to CPU (%s)", detail)
+    force_cpu()
+    return "cpu"

@@ -26,12 +26,12 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # interpreter via sitecustomize.  jax initializes ALL registered plugins on
 # first backend use even when JAX_PLATFORMS=cpu, so a slow/wedged TPU tunnel
 # would stall pure-CPU tests.  Deregister it for the test process.
-try:
-    import jax._src.xla_bridge as _xb
+import sys as _sys
 
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from locust_tpu.backend import force_cpu as _force_cpu
+
+_force_cpu()
 
 # The sitecustomize hook imports jax at interpreter start, BEFORE this file
 # runs — so jax has already captured JAX_PLATFORMS etc. from the ambient env.

@@ -1,0 +1,171 @@
+"""Backend selection resilience (locust_tpu/backend.py).
+
+The real TPU probe spawns a subprocess running ``jax.devices()``; here the
+probe source is monkeypatched so the suite exercises every outcome —
+success, non-zero exit, timeout, CPU-only — without a TPU or a wedged
+tunnel in the loop.
+"""
+
+import os
+import time
+
+import pytest
+
+from locust_tpu import backend
+
+
+@pytest.fixture(autouse=True)
+def isolated_probe_markers(tmp_path, monkeypatch):
+    """Each test gets its own (absent) probe-cache marker files."""
+    monkeypatch.setattr(backend, "_PROBE_OK_MARKER", str(tmp_path / "probe_ok"))
+    monkeypatch.setattr(
+        backend, "_PROBE_FAIL_MARKER", str(tmp_path / "probe_fail")
+    )
+
+
+def test_force_cpu_is_idempotent_and_pins_cpu():
+    backend.force_cpu()
+    backend.force_cpu()
+    import jax
+
+    assert jax.default_backend() == "cpu"
+
+
+def test_select_cpu_never_probes(monkeypatch):
+    def boom(**kwargs):  # pragma: no cover - must not be reached
+        raise AssertionError("cpu mode must not probe")
+
+    monkeypatch.setattr(backend, "probe_tpu", boom)
+    assert backend.select_backend("cpu") == "cpu"
+
+
+def test_auto_honors_jax_platforms_cpu_env(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def boom(**kwargs):  # pragma: no cover
+        raise AssertionError("explicit JAX_PLATFORMS=cpu must not probe")
+
+    monkeypatch.setattr(backend, "probe_tpu", boom)
+    assert backend.select_backend("auto") == "cpu"
+
+
+def test_probe_success_non_cpu_platform(monkeypatch):
+    monkeypatch.setattr(backend, "_PROBE_SRC", "print('PLATFORM=faketpu')")
+    ok, detail = backend.probe_tpu(timeout_s=30, retries=1)
+    assert ok and "faketpu" in detail
+    # Success leaves a marker; a fresh marker short-circuits the next probe
+    # (no subprocess — a hanging source would otherwise time out).
+    assert os.path.exists(backend._PROBE_OK_MARKER)
+    monkeypatch.setattr(backend, "_PROBE_SRC", "import time; time.sleep(30)")
+    ok, detail = backend.probe_tpu(timeout_s=0.5, retries=1)
+    assert ok and "cached" in detail
+
+
+def test_probe_failure_cached(monkeypatch):
+    monkeypatch.setattr(backend, "_PROBE_SRC", "raise SystemExit(3)")
+    ok, _ = backend.probe_tpu(timeout_s=30, retries=1)
+    assert not ok
+    assert os.path.exists(backend._PROBE_FAIL_MARKER)
+    # A fresh failure marker short-circuits: no subprocess, instant answer.
+    monkeypatch.setattr(backend, "_PROBE_SRC", "print('PLATFORM=faketpu')")
+    ok, detail = backend.probe_tpu(timeout_s=30, retries=1)
+    assert not ok and "cached" in detail
+
+
+def test_probe_marker_expires(monkeypatch):
+    with open(backend._PROBE_OK_MARKER, "w") as f:
+        f.write("faketpu")
+    old = time.time() - backend._PROBE_OK_TTL_S - 1
+    os.utime(backend._PROBE_OK_MARKER, (old, old))
+    monkeypatch.setattr(backend, "_PROBE_SRC", "raise SystemExit(3)")
+    ok, _ = backend.probe_tpu(timeout_s=30, retries=1)
+    assert not ok
+
+
+def test_probe_rejects_cpu_only_platform(monkeypatch):
+    monkeypatch.setattr(backend, "_PROBE_SRC", "print('PLATFORM=cpu')")
+    ok, detail = backend.probe_tpu(timeout_s=30, retries=1)
+    assert not ok and "CPU" in detail
+
+
+def test_probe_retries_then_reports_failure(monkeypatch, tmp_path):
+    # The child appends to a file each attempt, then fails: retry count is
+    # observable from the parent.
+    marker = tmp_path / "attempts"
+    monkeypatch.setattr(
+        backend,
+        "_PROBE_SRC",
+        f"open({str(marker)!r}, 'a').write('x'); raise SystemExit(3)",
+    )
+    ok, detail = backend.probe_tpu(timeout_s=30, retries=2, backoff_s=0.01)
+    assert not ok and "rc=3" in detail
+    assert marker.read_text() == "xx"
+
+
+def test_probe_timeout(monkeypatch):
+    monkeypatch.setattr(backend, "_PROBE_SRC", "import time; time.sleep(30)")
+    ok, detail = backend.probe_tpu(timeout_s=0.5, retries=1)
+    assert not ok and "timed out" in detail
+
+
+def test_tpu_mode_raises_when_unavailable(monkeypatch):
+    monkeypatch.setattr(backend, "probe_tpu", lambda **kw: (False, "down"))
+    with pytest.raises(RuntimeError, match="down"):
+        backend.select_backend("tpu")
+
+
+def test_auto_falls_back_to_cpu(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(backend, "probe_tpu", lambda **kw: (False, "down"))
+    assert backend.select_backend("auto") == "cpu"
+
+
+def test_auto_selects_tpu_on_probe_pass(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(backend, "probe_tpu", lambda **kw: (True, "up"))
+    # The real unpin would lift this CPU-pinned test process's platform pin.
+    unpinned = []
+    monkeypatch.setattr(backend, "_unpin_platforms", lambda: unpinned.append(1))
+    monkeypatch.setattr(backend, "_eager_init", lambda t: "faketpu")
+    assert backend.select_backend("auto") == "tpu"
+    assert unpinned  # tpu selection must clear any CPU pin (round-2 review)
+
+
+def test_auto_demotes_when_own_init_lands_on_cpu(monkeypatch):
+    # Probe passed but THIS process's init resolved to CPU (e.g. plugin
+    # failed fast under unpinned platforms): auto degrades, tpu raises.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(backend, "probe_tpu", lambda **kw: (True, "up"))
+    monkeypatch.setattr(backend, "_unpin_platforms", lambda: None)
+    monkeypatch.setattr(backend, "_eager_init", lambda t: "cpu")
+    assert backend.select_backend("auto") == "cpu"
+    with pytest.raises(RuntimeError, match="landed on CPU"):
+        backend.select_backend("tpu")
+
+
+def test_eager_init_watchdog_fires_in_child():
+    # The watchdog must os._exit the process on a hung init; exercise it in
+    # a subprocess with a stubbed hanging jax.
+    import subprocess, sys, textwrap
+
+    src = textwrap.dedent("""
+        import sys, time, types
+        sys.path.insert(0, %r)
+        from locust_tpu import backend  # real jax import, backends untouched
+        fake = types.ModuleType("jax")
+        fake.devices = lambda: time.sleep(60)
+        sys.modules["jax"] = fake       # _eager_init's own import sees this
+        backend._eager_init(0.5)
+        print("UNREACHABLE")
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True, timeout=30
+    )
+    assert proc.returncode == 3
+    assert "backend init exceeded" in proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+
+
+def test_invalid_mode():
+    with pytest.raises(ValueError):
+        backend.select_backend("gpu")
