@@ -75,12 +75,20 @@ class DistributedSort:
 
             # 1. SAMPLE: prefer VALID rows (padding rows would drag splitters
             # to zero and funnel every real key into one overflowing bin) —
-            # compact valid rows to the front with a 1-key sort, sample the
-            # prefix, and carry each sample's validity flag.
+            # compact valid rows to the front with a 1-key sort, then sample
+            # the valid prefix AT A STRIDE: shard_rows hands each device a
+            # contiguous (often internally clustered) line range, so a
+            # prefix sample would bias the splitters and skew the bins.
             inv = (~valid).astype(jnp.uint32)
             row_idx = jnp.arange(lanes.shape[0], dtype=jnp.int32)
             _, compact_idx = jax.lax.sort((inv, row_idx), num_keys=1)
-            take = compact_idx[:sample_per_device]           # valid-first rows
+            n_valid_local = jnp.sum(valid.astype(jnp.int32))
+            s = sample_per_device
+            # floor(i*n/s) computed without the i*n product, which would
+            # wrap int32 once rows_per_device * s exceeds 2^31 (x64 is off).
+            i = jnp.arange(s, dtype=jnp.int32)
+            stride_idx = i * (n_valid_local // s) + (i * (n_valid_local % s)) // s
+            take = compact_idx[jnp.clip(stride_idx, 0, lanes.shape[0] - 1)]
             sample = lanes[take]                             # [s, L]
             sample_ok = valid[take]                          # [s]
             all_samples = jax.lax.all_gather(sample, axis)   # [n_dev, s, L]
@@ -104,7 +112,7 @@ class DistributedSort:
                 packing.lanes_geq_table(lanes, splitters).astype(jnp.int32),
                 axis=-1,
             ).astype(jnp.uint32)                             # [N] in [0, n_dev)
-            send_lanes, send_vals, send_valid, overflow = partition_to_bins(
+            send_lanes, send_vals, send_valid, overflow, _ = partition_to_bins(
                 kv, n_dev, self.bin_capacity, bucket=bucket
             )
             recv_lanes = jax.lax.all_to_all(send_lanes, axis, 0, 0)
@@ -167,7 +175,20 @@ class SortResult:
         self.shard_capacity = shard_capacity
 
     def to_host_sorted(self) -> list[tuple[bytes, int]]:
-        """Concatenate per-device sorted valid prefixes -> global order."""
+        """Concatenate per-device sorted valid prefixes -> global order.
+
+        Warns loudly if rows were dropped (overflowed range bins): the
+        result is then NOT a permutation of the input — re-sort with a
+        higher skew_factor (sort_strings does this automatically).
+        """
+        if self.overflow:
+            import logging
+
+            logging.getLogger("locust_tpu").warning(
+                "sample sort dropped %d rows (range-bin overflow); "
+                "output is truncated — raise skew_factor",
+                self.overflow,
+            )
         if jax.process_count() > 1:  # pragma: no cover - multihost gather
             from jax.experimental import multihost_utils
 
@@ -199,12 +220,35 @@ def sort_strings(
     strings: list[bytes],
     mesh: jax.sharding.Mesh,
     cfg: EngineConfig | None = None,
+    max_retries: int | None = None,
     **kw,
 ) -> list[bytes]:
-    """Convenience: globally sort byte strings, truncated to key_width."""
+    """Convenience: globally sort byte strings, truncated to key_width.
+
+    Lossless: if a skewed/duplicate-heavy distribution overflows a range
+    bin, the sort is retried with DOUBLED skew_factor (bigger bins).  The
+    default budget doubles until ``skew_factor >= n_dev``, at which point
+    one bin holds an entire device shard and overflow is impossible — so
+    the default path cannot fail on ANY input that fits the mesh.  An
+    explicit ``max_retries`` caps the doublings instead, raising
+    ``ValueError`` rather than returning a silently truncated "sorted"
+    list (round-1 advisor finding: the old code dropped rows with only a
+    counter).
+    """
     cfg = cfg or EngineConfig()
     n_dev = mesh.shape[DATA_AXIS]
     rows_per_device = _round_up(max(1, -(-len(strings) // n_dev)), 8)
-    ds = DistributedSort(mesh, cfg, rows_per_device, **kw)
     rows = bytes_ops.strings_to_rows(strings, cfg.key_width)
-    return [k for k, _ in ds.sort_rows(rows).to_host_sorted()]
+    skew = kw.pop("skew_factor", 2.0)
+    if max_retries is None:
+        max_retries = max(1, math.ceil(math.log2(max(2.0, n_dev / skew))) + 1)
+    for _ in range(max_retries + 1):
+        ds = DistributedSort(mesh, cfg, rows_per_device, skew_factor=skew, **kw)
+        res = ds.sort_rows(rows)
+        if res.overflow == 0:
+            return [k for k, _ in res.to_host_sorted()]
+        skew *= 2.0
+    raise ValueError(
+        f"sample sort still dropped {res.overflow} rows at "
+        f"skew_factor={skew / 2}; input too skewed for this mesh"
+    )

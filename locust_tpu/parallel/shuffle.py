@@ -47,16 +47,29 @@ def _round_up(x: int, m: int) -> int:
 
 
 def partition_to_bins(
-    batch: KVBatch, n_bins: int, bin_capacity: int, bucket: jax.Array | None = None
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    batch: KVBatch,
+    n_bins: int,
+    bin_capacity: int,
+    bucket: jax.Array | None = None,
+    leftover_capacity: int = 0,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, KVBatch]:
     """Scatter a batch into ``[n_bins, capacity]`` by key hash.
 
     ``bucket`` overrides the destination-bin assignment (uint32 ``[N]`` in
     ``[0, n_bins)``) — used by range partitioners (apps/sample_sort.py);
     default is the hash partition.
 
-    Returns (lanes [B,C,L], values [B,C], valid [B,C], overflow []) where
-    overflow counts live entries dropped because their bin was full.
+    Live entries that do not fit their bin land in a compacted LEFTOVER
+    buffer of ``leftover_capacity`` rows instead of being dropped — the
+    caller re-shuffles them in a follow-up round (the SURVEY §7.3.3
+    "overflow round" mitigation for skew; the reference's analogous
+    WARN-and-drop at main.cu:141-144 is a bug, not a contract).  With
+    ``leftover_capacity=0`` overspill is dropped and counted, the
+    reference-style behavior.
+
+    Returns (lanes [B,C,L], values [B,C], valid [B,C], overflow [],
+    leftover KVBatch[leftover_capacity]); overflow counts live entries that
+    fit neither their bin nor the leftover buffer — true data loss.
     """
     lanes, values, valid = batch.key_lanes, batch.values, batch.valid
     n, n_lanes = lanes.shape
@@ -81,7 +94,7 @@ def partition_to_bins(
     within = jnp.arange(n, dtype=jnp.int32) - offsets[sb]
 
     ok = svalid & (within < bin_capacity)
-    overflow = jnp.sum((svalid & (within >= bin_capacity)).astype(jnp.int32))
+    spill = svalid & (within >= bin_capacity)
     dump = n_bins * bin_capacity
     dest = jnp.where(ok, sb * bin_capacity + within, dump)
 
@@ -95,7 +108,21 @@ def partition_to_bins(
     out_valid = (
         jnp.zeros((flat + 1,), bool).at[dest].set(ok)[:flat]
     ).reshape(n_bins, bin_capacity)
-    return out_lanes, out_vals, out_valid, overflow
+
+    # Compact spilled entries into the leftover buffer (same scatter trick).
+    lcap = leftover_capacity
+    lrank = jnp.cumsum(spill.astype(jnp.int32)) - 1
+    kept = spill & (lrank < lcap)
+    ldest = jnp.where(kept, lrank, lcap)
+    leftover = KVBatch(
+        key_lanes=jnp.zeros((lcap + 1, n_lanes), lanes.dtype)
+        .at[ldest]
+        .set(slanes)[:lcap],
+        values=jnp.zeros((lcap + 1,), svals.dtype).at[ldest].set(svals)[:lcap],
+        valid=jnp.zeros((lcap + 1,), bool).at[ldest].set(kept)[:lcap],
+    )
+    overflow = jnp.sum((spill & (lrank >= lcap)).astype(jnp.int32))
+    return out_lanes, out_vals, out_valid, overflow, leftover
 
 
 class DistributedMapReduce:
@@ -115,11 +142,15 @@ class DistributedMapReduce:
         map_fn=wordcount_map,
         combine: str = "sum",
         skew_factor: float = 2.0,
+        on_overflow: str = "retry",
     ):
+        if on_overflow not in ("retry", "drop"):
+            raise ValueError(f"on_overflow must be 'retry' or 'drop', got {on_overflow!r}")
         self.mesh = mesh
         self.cfg = cfg
         self.axis = axis_name
         self.combine = combine
+        self.on_overflow = on_overflow
         self.n_dev = mesh.shape[axis_name]
         # Per-destination bin capacity: fair share of the local table,
         # padded for skew, TPU-lane aligned.
@@ -128,16 +159,30 @@ class DistributedMapReduce:
         )
         # Received rows per device per round; also the shard table capacity.
         self.shard_capacity = self.n_dev * self.bin_capacity
+        # Carried backlog of entries whose destination bin was full; they
+        # re-enter the shuffle next round ("retry" mode).  emits_per_block
+        # bounds one round's distinct keys, and run() drains the backlog to
+        # zero between rounds, so this never overflows (see run()).
+        self.leftover_capacity = cfg.emits_per_block if on_overflow == "retry" else 0
         n_lanes = cfg.key_lanes
         axis = axis_name
 
-        def local_step(lines: jax.Array, acc: KVBatch):
+        def local_step(lines: jax.Array, acc: KVBatch, leftover: KVBatch):
             """Per-device body (runs under shard_map)."""
             kv, emit_ovf = map_fn(lines, cfg)
             local_table = segment_reduce(sort_and_compact(kv, cfg.sort_mode), combine)
 
-            send_lanes, send_vals, send_valid, shuf_ovf = partition_to_bins(
-                local_table, self.n_dev, self.bin_capacity
+            # The carried backlog joins at the PARTITION (whose internal
+            # grouping sort is single-key — cheap), not the full local sort:
+            # a key present both in the backlog and in new emits is sent
+            # twice and merges at its destination's segment reduce.
+            send_lanes, send_vals, send_valid, shuf_ovf, new_leftover = (
+                partition_to_bins(
+                    KVBatch.concat(local_table, leftover),
+                    self.n_dev,
+                    self.bin_capacity,
+                    leftover_capacity=self.leftover_capacity,
+                )
             )
             # The ICI shuffle: one all-to-all per tensor.
             recv_lanes = jax.lax.all_to_all(send_lanes, axis, 0, 0)
@@ -156,6 +201,7 @@ class DistributedMapReduce:
                 self.shard_capacity,
                 combine,
             )
+            backlog = jnp.sum(new_leftover.valid.astype(jnp.int32))
             # Global scalar stats ride psum — the "final combine" collective.
             # psum output is identical on every device, so the stats leave
             # shard_map REPLICATED (out_spec P()): every process can read
@@ -165,17 +211,18 @@ class DistributedMapReduce:
                     jax.lax.psum(emit_ovf, axis),
                     jax.lax.psum(shuf_ovf, axis),
                     jax.lax.psum(distinct, axis),
+                    jax.lax.psum(backlog, axis),
                 ]
             )
-            return new_acc, stats
+            return new_acc, new_leftover, stats
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
         self._step = jax.jit(
             jax.shard_map(
                 local_step,
                 mesh=mesh,
-                in_specs=(P(axis), kv_spec),
-                out_specs=(kv_spec, P()),
+                in_specs=(P(axis), kv_spec, kv_spec),
+                out_specs=(kv_spec, kv_spec, P()),
             )
         )
 
@@ -189,8 +236,22 @@ class DistributedMapReduce:
         """Global (sharded) empty accumulator: one shard per device."""
         return KVBatch.empty(self.n_dev * self.shard_capacity, self.cfg.key_lanes)
 
-    def run(self, rows, shard_fn=None) -> "DistributedResult":
-        """Run the full corpus; ``rows`` is a host ``[n, line_width]`` array."""
+    def empty_leftover(self) -> KVBatch:
+        """Global (sharded) empty shuffle-backlog buffer (0 rows in drop mode)."""
+        return KVBatch.empty(
+            self.n_dev * self.leftover_capacity, self.cfg.key_lanes
+        )
+
+    def run(self, rows, shard_fn=None, max_drain_rounds: int | None = None) -> "DistributedResult":
+        """Run the full corpus; ``rows`` is a host ``[n, line_width]`` array.
+
+        In ``on_overflow="retry"`` mode (default) each feed round is
+        followed by drain rounds — empty input, backlog only — until every
+        device's shuffle backlog is empty, so bin overflow NEVER loses
+        data.  Each drain moves >= 1 entry per backlogged destination, so
+        at most ceil(emits_per_block / bin_capacity) drains are needed; a
+        safety cap raises instead of looping forever.
+        """
         import numpy as np
 
         from locust_tpu.parallel.mesh import shard_rows
@@ -198,31 +259,74 @@ class DistributedMapReduce:
         lpr = self.lines_per_round
         n = rows.shape[0]
         nrounds = max(1, -(-n // lpr))
-        acc = jax.device_put(
-            self.empty_table(),
-            jax.sharding.NamedSharding(self.mesh, P(self.axis)),
-        )
+        sharding = jax.sharding.NamedSharding(self.mesh, P(self.axis))
+        acc = jax.device_put(self.empty_table(), sharding)
+        leftover = jax.device_put(self.empty_leftover(), sharding)
+        if max_drain_rounds is None:
+            max_drain_rounds = 2 + -(-self.cfg.emits_per_block // self.bin_capacity)
+        zero_chunk = None
         emit_ovf = shuf_ovf = 0
         distinct = 0
+        drains_used = 0
         for r in range(nrounds):
             chunk = rows[r * lpr : (r + 1) * lpr]
             if chunk.shape[0] < lpr:
                 pad = np.zeros((lpr - chunk.shape[0], rows.shape[1]), np.uint8)
                 chunk = np.concatenate([chunk, pad]) if chunk.size else pad
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
-            acc, stats = self._step(sharded, acc)
+            acc, leftover, stats = self._step(sharded, acc, leftover)
             # Overflows accumulate across rounds; distinct is a property of
             # the final merged table, so the last round's value stands.
             round_stats = jax.device_get(stats)  # replicated: host-local read
             emit_ovf += int(round_stats[0])
             shuf_ovf += int(round_stats[1])
             distinct = int(round_stats[2])
+            backlog = int(round_stats[3])
+            if shuf_ovf and self.on_overflow == "retry":
+                # Spill past the leftover buffer = data ALREADY lost;
+                # retry mode must fail loudly, not tally quietly.  Only
+                # reachable if a custom map_fn violates the emits_per_block
+                # bound (the buffer is sized to make it impossible for the
+                # built-in pipeline).
+                raise RuntimeError(
+                    f"shuffle lost {shuf_ovf} entries despite retry mode; "
+                    "map_fn emitted more than cfg.emits_per_block live rows"
+                )
+            # Drain the shuffle backlog before feeding more input: keeps the
+            # leftover buffer's no-loss invariant (one round adds at most
+            # emits_per_block distinct keys to an EMPTY backlog).
+            for _ in range(max_drain_rounds):
+                if backlog == 0:
+                    break
+                if zero_chunk is None:
+                    zero_chunk = (shard_fn or shard_rows)(
+                        np.zeros((lpr, rows.shape[1]), np.uint8),
+                        self.mesh,
+                        self.axis,
+                    )
+                acc, leftover, stats = self._step(zero_chunk, acc, leftover)
+                round_stats = jax.device_get(stats)
+                shuf_ovf += int(round_stats[1])
+                distinct = int(round_stats[2])
+                backlog = int(round_stats[3])
+                drains_used += 1
+            if shuf_ovf and self.on_overflow == "retry":
+                raise RuntimeError(
+                    f"shuffle lost {shuf_ovf} entries despite retry mode; "
+                    "map_fn emitted more than cfg.emits_per_block live rows"
+                )
+            if backlog:
+                raise RuntimeError(
+                    f"shuffle backlog failed to drain in {max_drain_rounds} "
+                    f"rounds ({backlog} entries remain); raise skew_factor"
+                )
         return DistributedResult(
             table=acc,
             emit_overflow=emit_ovf,
             shuffle_overflow=shuf_ovf,
             distinct=distinct,
             combine=self.combine,
+            drain_rounds=drains_used,
         )
 
 
@@ -234,12 +338,14 @@ class DistributedResult:
         shuffle_overflow: int,
         distinct: int,
         combine: str = "sum",
+        drain_rounds: int = 0,
     ):
         self.table = table
-        self.emit_overflow = emit_overflow
-        self.shuffle_overflow = shuffle_overflow
+        self.emit_overflow = emit_overflow    # tokens beyond the per-line cap
+        self.shuffle_overflow = shuffle_overflow  # entries LOST in the shuffle
         self.distinct = distinct
         self.combine = combine
+        self.drain_rounds = drain_rounds      # extra all-to-all rounds used
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Gather all shards; optionally re-sort to global key order.

@@ -141,13 +141,26 @@ def test_distributed_sample_sort_carries_values():
 
 
 def test_distributed_sample_sort_duplicate_heavy():
+    """Duplicate-heavy skew must be absorbed WITHOUT the caller hand-tuning
+    skew_factor: sort_strings retries with doubled bins until lossless
+    (round-1 advisor finding — the old default silently dropped rows)."""
     from locust_tpu.apps.sample_sort import sort_strings
     from locust_tpu.parallel import make_mesh
 
     words = [b"same"] * 300 + [b"other"] * 200 + [b"zz", b"aa"] * 50
     cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
-    got = sort_strings(words, make_mesh(8), cfg, skew_factor=8.0)
+    got = sort_strings(words, make_mesh(8), cfg)
     assert got == sorted(words)
+
+
+def test_distributed_sample_sort_raises_after_retry_budget():
+    from locust_tpu.apps.sample_sort import sort_strings
+    from locust_tpu.parallel import make_mesh
+
+    words = [b"same"] * 512  # one range bin gets everything
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    with pytest.raises(ValueError, match="dropped"):
+        sort_strings(words, make_mesh(8), cfg, max_retries=0, skew_factor=0.25)
 
 
 def test_distributed_sample_sort_mostly_padding():

@@ -36,7 +36,7 @@ def test_partition_to_bins_routes_by_hash():
     batch = KVBatch.from_bytes(
         keys, jnp.arange(50), jnp.ones(50, bool)
     )
-    lanes, vals, valid, overflow = partition_to_bins(batch, 4, 32)
+    lanes, vals, valid, overflow, _ = partition_to_bins(batch, 4, 32)
     assert lanes.shape == (4, 32, 8) and int(overflow) == 0
     # Every live entry landed in the bin its hash names.
     h = np.asarray(packing.fold_hash(batch.key_lanes)) % 4
@@ -49,8 +49,42 @@ def test_partition_overflow_counted():
     words = [b"same"] * 20  # all hash to one bin
     keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
     batch = KVBatch.from_bytes(keys, jnp.ones(20, jnp.int32), jnp.ones(20, bool))
-    _, _, valid, overflow = partition_to_bins(batch, 4, 8)
+    _, _, valid, overflow, leftover = partition_to_bins(batch, 4, 8)
     assert int(overflow) == 12 and int(np.asarray(valid).sum()) == 8
+    assert leftover.key_lanes.shape[0] == 0  # no buffer requested -> dropped
+
+
+def test_partition_spill_lands_in_leftover():
+    """With a leftover buffer, bin overspill is captured, not lost."""
+    words = [b"same"] * 20  # all hash to one bin
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
+    vals = jnp.arange(20, dtype=jnp.int32)
+    batch = KVBatch.from_bytes(keys, vals, jnp.ones(20, bool))
+    _, binned_vals, valid, overflow, leftover = partition_to_bins(
+        batch, 4, 8, leftover_capacity=16
+    )
+    assert int(overflow) == 0
+    assert int(np.asarray(valid).sum()) == 8
+    assert int(np.asarray(leftover.valid).sum()) == 12
+    # Every input value appears exactly once: in a bin or in the leftover.
+    got = sorted(
+        np.asarray(binned_vals)[np.asarray(valid)].tolist()
+        + np.asarray(leftover.values)[np.asarray(leftover.valid)].tolist()
+    )
+    assert got == list(range(20))
+
+
+def test_partition_leftover_overflow_still_counted():
+    """Spill beyond the leftover buffer is true loss and must be counted."""
+    words = [b"same"] * 20
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 32))
+    batch = KVBatch.from_bytes(keys, jnp.ones(20, jnp.int32), jnp.ones(20, bool))
+    _, _, valid, overflow, leftover = partition_to_bins(
+        batch, 4, 8, leftover_capacity=5
+    )
+    assert int(np.asarray(valid).sum()) == 8
+    assert int(np.asarray(leftover.valid).sum()) == 5
+    assert int(overflow) == 7
 
 
 def test_distributed_wordcount_matches_oracle():
@@ -105,6 +139,45 @@ def test_distributed_overflow_accumulates_across_rounds():
     rows = bytes_ops.strings_to_rows(busy + clean, cfg.line_width)
     res = dmr.run(rows)
     assert res.emit_overflow == 2 * 16
+
+
+def test_distributed_skew_beyond_bins_is_lossless():
+    """VERDICT.md round-1 #3: distinct-key skew exceeding bin_capacity used
+    to silently drop counts.  retry mode drains the backlog in extra
+    all-to-all rounds: the result must match the oracle EXACTLY."""
+    mesh = make_mesh(8)
+    cfg = small_cfg()
+    # skew_factor well below 1 forces tiny bins: emits_per_block=128 over
+    # 8 devices -> fair share 16; x0.1 -> bin_capacity 8 (after rounding).
+    dmr = DistributedMapReduce(mesh, cfg, skew_factor=0.1)
+    assert dmr.bin_capacity == 8
+    rng = np.random.default_rng(11)
+    vocab = [f"word{i}".encode() for i in range(300)]
+    lines = [
+        b" ".join(rng.choice(vocab, size=6).tolist()) for _ in range(256)
+    ]
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    expect = py_wordcount(lines, cfg.emits_per_line, cfg.key_width)
+    assert dict(res.to_host_pairs()) == dict(expect)
+    assert res.shuffle_overflow == 0
+    assert res.drain_rounds > 0  # the skew actually exercised the backlog
+
+
+def test_distributed_drop_mode_preserves_reference_behavior():
+    """on_overflow='drop' keeps the counted-loss contract for comparison."""
+    mesh = make_mesh(8)
+    cfg = small_cfg()
+    dmr = DistributedMapReduce(mesh, cfg, skew_factor=0.1, on_overflow="drop")
+    rng = np.random.default_rng(11)
+    vocab = [f"word{i}".encode() for i in range(300)]
+    lines = [
+        b" ".join(rng.choice(vocab, size=6).tolist()) for _ in range(256)
+    ]
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    assert res.shuffle_overflow > 0  # loss happened and was reported
+    assert res.drain_rounds == 0
 
 
 def test_distributed_output_sorted():
