@@ -304,21 +304,19 @@ class MapReduceEngine:
         re-run with a different corpus/config fingerprint starts fresh.
         Snapshots are a few MB (table_size rows) regardless of corpus size.
         """
-        import json
         import os
+
+        from locust_tpu.io.serde import fingerprint_corpus
 
         if every < 1:
             raise ValueError(f"checkpoint every must be >= 1, got {every}")
         os.makedirs(checkpoint_dir, exist_ok=True)
         state_path = os.path.join(checkpoint_dir, "state.npz")
-        fingerprint = json.dumps(
-            {
-                "n_rows": int(rows.shape[0]),
-                "cfg": repr(self.cfg),
-                "combine": self.combine,
-                "map_fn": getattr(self.map_fn, "__name__", str(self.map_fn)),
-            },
-            sort_keys=True,
+        fingerprint = fingerprint_corpus(
+            rows,
+            cfg=repr(self.cfg),
+            combine=self.combine,
+            map_fn=getattr(self.map_fn, "__name__", str(self.map_fn)),
         )
 
         start_block = 0
@@ -380,6 +378,14 @@ class MapReduceEngine:
         )
 
     def _finish(self, acc, num_segments, overflow, times) -> RunResult:
+        import os
+
+        if os.environ.get("LOCUST_DEBUG_CHECKS"):
+            # Opt-in invariant sweep on the result table (the sanitizer
+            # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.
+            from locust_tpu.utils.checks import validate_batch
+
+            validate_batch(acc, expect_compact=True)
         num = int(num_segments)
         truncated = num > acc.size
         if truncated:

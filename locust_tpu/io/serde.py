@@ -59,6 +59,30 @@ def read_tsv(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
     )
 
 
+def fingerprint_corpus(rows: np.ndarray, **extra) -> str:
+    """Resume-identity string for a checkpointed run over ``rows``.
+
+    Digests the corpus CONTENT, not just its shape — editing the corpus
+    without changing the line count must not resume from a stale snapshot
+    (round-1 advisor finding).  ``extra`` carries the pipeline identity
+    (config repr, combine, mesh, ...); one shared recipe so the engine and
+    the distributed runner can never drift apart.
+    """
+    import hashlib
+    import json
+
+    return json.dumps(
+        {
+            "n_rows": int(rows.shape[0]),
+            "digest": hashlib.sha256(
+                np.ascontiguousarray(rows).tobytes()
+            ).hexdigest(),
+            **extra,
+        },
+        sort_keys=True,
+    )
+
+
 def write_npz(batch: KVBatch, path: str) -> None:
     """Binary shard checkpoint: the packed device representation as-is."""
     np.savez_compressed(

@@ -26,6 +26,7 @@ fully determined by the hash function and key order.
 
 from __future__ import annotations
 
+import logging
 import math
 from functools import partial
 
@@ -41,9 +42,50 @@ from locust_tpu.ops.process_stage import sort_and_compact
 from locust_tpu.ops.reduce_stage import segment_reduce, segment_reduce_into
 from locust_tpu.parallel.mesh import DATA_AXIS
 
+logger = logging.getLogger("locust_tpu")
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+def feed_and_drain(
+    step,
+    feed: tuple,
+    zero_feed,
+    acc,
+    leftover,
+    max_drain_rounds: int,
+    backlog_idx: int,
+):
+    """One feed step + drain rounds until the shuffle backlog is empty.
+
+    The shared host-side retry protocol (SURVEY §7.3.3 overflow rounds)
+    used by DistributedMapReduce and DistributedInvertedIndex: run ``step``
+    on ``feed``, then repeat with ``zero_feed()`` (lazily built empty
+    input) while ``stats[backlog_idx]`` is nonzero.  Each drain moves at
+    least one entry per backlogged destination, so the loop terminates;
+    ``max_drain_rounds`` turns a violated invariant into an error instead
+    of an infinite loop.
+
+    Returns (acc, leftover, host_stats_per_step, drains_used).
+    """
+    acc, leftover, stats = step(*feed, acc, leftover)
+    st = jax.device_get(stats)
+    stats_list = [st]
+    drains = 0
+    while int(st[backlog_idx]) > 0:
+        if drains >= max_drain_rounds:
+            raise RuntimeError(
+                f"shuffle backlog failed to drain in {max_drain_rounds} "
+                f"rounds ({int(st[backlog_idx])} entries remain); raise "
+                "skew_factor"
+            )
+        acc, leftover, stats = step(*zero_feed(), acc, leftover)
+        st = jax.device_get(stats)
+        stats_list.append(st)
+        drains += 1
+    return acc, leftover, stats_list, drains
 
 
 def partition_to_bins(
@@ -143,6 +185,7 @@ class DistributedMapReduce:
         combine: str = "sum",
         skew_factor: float = 2.0,
         on_overflow: str = "retry",
+        shard_capacity: int | None = None,
     ):
         if on_overflow not in ("retry", "drop"):
             raise ValueError(f"on_overflow must be 'retry' or 'drop', got {on_overflow!r}")
@@ -157,8 +200,18 @@ class DistributedMapReduce:
         self.bin_capacity = _round_up(
             max(1, math.ceil(cfg.emits_per_block / self.n_dev * skew_factor)), 8
         )
-        # Received rows per device per round; also the shard table capacity.
-        self.shard_capacity = self.n_dev * self.bin_capacity
+        # Result-table rows per device (its hash shard of the global table).
+        # Decoupled from the per-round receive volume (n_dev * bin_capacity,
+        # the default) so a long corpus can accumulate a vocabulary far
+        # larger than one round's traffic; a shard's distinct keys exceeding
+        # this is reported via DistributedResult.truncated.
+        self.shard_capacity = (
+            shard_capacity
+            if shard_capacity is not None
+            else self.n_dev * self.bin_capacity
+        )
+        if self.shard_capacity < 1:
+            raise ValueError(f"shard_capacity must be >= 1, got {self.shard_capacity}")
         # Carried backlog of entries whose destination bin was full; they
         # re-enter the shuffle next round ("retry" mode).  emits_per_block
         # bounds one round's distinct keys, and run() drains the backlog to
@@ -202,16 +255,21 @@ class DistributedMapReduce:
                 combine,
             )
             backlog = jnp.sum(new_leftover.valid.astype(jnp.int32))
+            # Truncation is a PER-SHARD event: distinct keys arriving at one
+            # device beyond its table capacity are dropped there (mirror of
+            # RunResult.truncated, engine._finish).  pmax surfaces the worst
+            # shard's pre-slice distinct count.
             # Global scalar stats ride psum — the "final combine" collective.
-            # psum output is identical on every device, so the stats leave
-            # shard_map REPLICATED (out_spec P()): every process can read
-            # them without touching non-addressable shards.
+            # psum/pmax output is identical on every device, so the stats
+            # leave shard_map REPLICATED (out_spec P()): every process can
+            # read them without touching non-addressable shards.
             stats = jnp.stack(
                 [
                     jax.lax.psum(emit_ovf, axis),
                     jax.lax.psum(shuf_ovf, axis),
                     jax.lax.psum(distinct, axis),
                     jax.lax.psum(backlog, axis),
+                    jax.lax.pmax(distinct, axis),
                 ]
             )
             return new_acc, new_leftover, stats
@@ -242,7 +300,28 @@ class DistributedMapReduce:
             self.n_dev * self.leftover_capacity, self.cfg.key_lanes
         )
 
-    def run(self, rows, shard_fn=None, max_drain_rounds: int | None = None) -> "DistributedResult":
+    def _fingerprint(self, rows) -> str:
+        """Identity of a (corpus, pipeline, mesh) combination for resume."""
+        from locust_tpu.io.serde import fingerprint_corpus
+
+        return fingerprint_corpus(
+            rows,
+            cfg=repr(self.cfg),
+            combine=self.combine,
+            mesh=f"{self.n_dev}x{self.axis}",
+            bin_capacity=self.bin_capacity,
+            shard_capacity=self.shard_capacity,
+            on_overflow=self.on_overflow,
+        )
+
+    def run(
+        self,
+        rows,
+        shard_fn=None,
+        max_drain_rounds: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ) -> "DistributedResult":
         """Run the full corpus; ``rows`` is a host ``[n, line_width]`` array.
 
         In ``on_overflow="retry"`` mode (default) each feed round is
@@ -251,11 +330,22 @@ class DistributedMapReduce:
         data.  Each drain moves >= 1 entry per backlogged destination, so
         at most ceil(emits_per_block / bin_capacity) drains are needed; a
         safety cap raises instead of looping forever.
+
+        With ``checkpoint_dir``, every ``checkpoint_every`` completed
+        rounds the sharded accumulator + backlog + counters land in one
+        atomically-replaced npz per process; a re-run with the same
+        corpus/config/mesh fingerprint resumes after the last completed
+        round (the distributed upgrade of the reference's "map wrote
+        /tmp/out.txt, re-run reduce from it" persistence, main.cu:428-441).
         """
+        import os
+
         import numpy as np
 
         from locust_tpu.parallel.mesh import shard_rows
 
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         lpr = self.lines_per_round
         n = rows.shape[0]
         nrounds = max(1, -(-n // lpr))
@@ -268,20 +358,107 @@ class DistributedMapReduce:
         emit_ovf = shuf_ovf = 0
         distinct = 0
         drains_used = 0
-        for r in range(nrounds):
+        truncated = False
+        start_round = 0
+
+        state_path = fingerprint = None
+        if checkpoint_dir is not None:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            state_path = os.path.join(
+                checkpoint_dir, f"state.p{jax.process_index()}.npz"
+            )
+            fingerprint = self._fingerprint(rows)
+            if os.path.exists(state_path):
+                with np.load(state_path) as z:
+                    if str(z["fingerprint"]) == fingerprint:
+                        start_round = int(z["next_round"])
+                        emit_ovf = int(z["emit_ovf"])
+                        shuf_ovf = int(z["shuf_ovf"])
+                        distinct = int(z["distinct"])
+                        drains_used = int(z["drains_used"])
+                        truncated = bool(z["truncated"])
+                        acc = jax.device_put(
+                            KVBatch(
+                                key_lanes=z["acc_key_lanes"],
+                                values=z["acc_values"],
+                                valid=z["acc_valid"],
+                            ),
+                            sharding,
+                        )
+                        leftover = jax.device_put(
+                            KVBatch(
+                                key_lanes=z["left_key_lanes"],
+                                values=z["left_values"],
+                                valid=z["left_valid"],
+                            ),
+                            sharding,
+                        )
+                        logger.info(
+                            "resuming distributed run at round %d (%s)",
+                            start_round,
+                            checkpoint_dir,
+                        )
+                    else:
+                        logger.warning(
+                            "checkpoint at %s belongs to a different run; "
+                            "starting fresh",
+                            checkpoint_dir,
+                        )
+
+        def snapshot(next_round: int) -> None:
+            acc_h = _gather_batch_host(acc)
+            left_h = _gather_batch_host(leftover)
+            tmp = state_path + ".tmp.npz"
+            np.savez_compressed(
+                tmp,
+                acc_key_lanes=acc_h.key_lanes,
+                acc_values=acc_h.values,
+                acc_valid=acc_h.valid,
+                left_key_lanes=left_h.key_lanes,
+                left_values=left_h.values,
+                left_valid=left_h.valid,
+                next_round=np.int64(next_round),
+                emit_ovf=np.int64(emit_ovf),
+                shuf_ovf=np.int64(shuf_ovf),
+                distinct=np.int64(distinct),
+                drains_used=np.int64(drains_used),
+                truncated=np.bool_(truncated),
+                fingerprint=np.str_(fingerprint),
+            )
+            os.replace(tmp, state_path)
+
+        def zero_feed():
+            nonlocal zero_chunk
+            if zero_chunk is None:
+                zero_chunk = (shard_fn or shard_rows)(
+                    np.zeros((lpr, rows.shape[1]), np.uint8),
+                    self.mesh,
+                    self.axis,
+                )
+            return (zero_chunk,)
+
+        last_snapshot = start_round
+        for r in range(start_round, nrounds):
             chunk = rows[r * lpr : (r + 1) * lpr]
             if chunk.shape[0] < lpr:
                 pad = np.zeros((lpr - chunk.shape[0], rows.shape[1]), np.uint8)
                 chunk = np.concatenate([chunk, pad]) if chunk.size else pad
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
-            acc, leftover, stats = self._step(sharded, acc, leftover)
-            # Overflows accumulate across rounds; distinct is a property of
-            # the final merged table, so the last round's value stands.
-            round_stats = jax.device_get(stats)  # replicated: host-local read
-            emit_ovf += int(round_stats[0])
-            shuf_ovf += int(round_stats[1])
-            distinct = int(round_stats[2])
-            backlog = int(round_stats[3])
+            # Feed + drain-the-backlog-to-empty: keeps the leftover buffer's
+            # no-loss invariant (one round adds at most emits_per_block
+            # distinct keys to an EMPTY backlog).
+            acc, leftover, stats_list, drains = feed_and_drain(
+                self._step, (sharded,), zero_feed, acc, leftover,
+                max_drain_rounds, backlog_idx=3,
+            )
+            drains_used += drains
+            for st in stats_list:
+                # Overflows accumulate across steps; distinct is a property
+                # of the final merged table, so the last value stands.
+                emit_ovf += int(st[0])
+                shuf_ovf += int(st[1])
+                distinct = int(st[2])
+                truncated |= int(st[4]) > self.shard_capacity
             if shuf_ovf and self.on_overflow == "retry":
                 # Spill past the leftover buffer = data ALREADY lost;
                 # retry mode must fail loudly, not tally quietly.  Only
@@ -292,34 +469,17 @@ class DistributedMapReduce:
                     f"shuffle lost {shuf_ovf} entries despite retry mode; "
                     "map_fn emitted more than cfg.emits_per_block live rows"
                 )
-            # Drain the shuffle backlog before feeding more input: keeps the
-            # leftover buffer's no-loss invariant (one round adds at most
-            # emits_per_block distinct keys to an EMPTY backlog).
-            for _ in range(max_drain_rounds):
-                if backlog == 0:
-                    break
-                if zero_chunk is None:
-                    zero_chunk = (shard_fn or shard_rows)(
-                        np.zeros((lpr, rows.shape[1]), np.uint8),
-                        self.mesh,
-                        self.axis,
-                    )
-                acc, leftover, stats = self._step(zero_chunk, acc, leftover)
-                round_stats = jax.device_get(stats)
-                shuf_ovf += int(round_stats[1])
-                distinct = int(round_stats[2])
-                backlog = int(round_stats[3])
-                drains_used += 1
-            if shuf_ovf and self.on_overflow == "retry":
-                raise RuntimeError(
-                    f"shuffle lost {shuf_ovf} entries despite retry mode; "
-                    "map_fn emitted more than cfg.emits_per_block live rows"
-                )
-            if backlog:
-                raise RuntimeError(
-                    f"shuffle backlog failed to drain in {max_drain_rounds} "
-                    f"rounds ({backlog} entries remain); raise skew_factor"
-                )
+            if state_path is not None and (r + 1) % checkpoint_every == 0:
+                snapshot(r + 1)
+                last_snapshot = r + 1
+        if state_path is not None and last_snapshot != nrounds:
+            snapshot(nrounds)
+        if truncated:
+            logger.warning(
+                "a shard's distinct keys exceeded its table capacity (%d); "
+                "tail keys dropped — raise shard_capacity",
+                self.shard_capacity,
+            )
         return DistributedResult(
             table=acc,
             emit_overflow=emit_ovf,
@@ -327,7 +487,33 @@ class DistributedMapReduce:
             distinct=distinct,
             combine=self.combine,
             drain_rounds=drains_used,
+            truncated=truncated,
         )
+
+
+def _gather_batch_host(table: KVBatch) -> KVBatch:
+    """Gather a (possibly multi-process sharded) KVBatch to host numpy.
+
+    Multi-process: every process gathers ALL shards (process_allgather over
+    DCN) and holds the identical full table.
+    """
+    import numpy as np
+
+    if jax.process_count() > 1:  # pragma: no cover - needs multihost
+        from jax.experimental import multihost_utils
+
+        lanes, values, valid = multihost_utils.process_allgather(
+            (table.key_lanes, table.values, table.valid), tiled=True
+        )
+    else:
+        lanes, values, valid = jax.device_get(
+            (table.key_lanes, table.values, table.valid)
+        )
+    return KVBatch(
+        key_lanes=np.asarray(lanes),
+        values=np.asarray(values),
+        valid=np.asarray(valid),
+    )
 
 
 class DistributedResult:
@@ -339,6 +525,7 @@ class DistributedResult:
         distinct: int,
         combine: str = "sum",
         drain_rounds: int = 0,
+        truncated: bool = False,
     ):
         self.table = table
         self.emit_overflow = emit_overflow    # tokens beyond the per-line cap
@@ -346,6 +533,7 @@ class DistributedResult:
         self.distinct = distinct
         self.combine = combine
         self.drain_rounds = drain_rounds      # extra all-to-all rounds used
+        self.truncated = truncated            # a shard's table overflowed
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Gather all shards; optionally re-sort to global key order.
@@ -358,12 +546,4 @@ class DistributedResult:
         """
         from locust_tpu.engine import finalize_host_pairs
 
-        table = self.table
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            lanes, values, valid = multihost_utils.process_allgather(
-                (table.key_lanes, table.values, table.valid), tiled=True
-            )
-            table = KVBatch(key_lanes=lanes, values=values, valid=valid)
-        return finalize_host_pairs(table, self.combine, sort)
+        return finalize_host_pairs(_gather_batch_host(self.table), self.combine, sort)
