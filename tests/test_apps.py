@@ -108,6 +108,58 @@ def test_inverted_index_mismatched_doc_ids_raises():
         build_inverted_index([b"a", b"b"], np.arange(3), cfg)
 
 
+# ------------------------------------------------------- distributed index
+
+def test_distributed_inverted_index_matches_oracle():
+    """VERDICT.md round-1 #7: the mesh index must match the single-device
+    oracle on a corpus spanning several shuffle rounds."""
+    from locust_tpu.apps.inverted_index import build_inverted_index_mesh
+    from locust_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(5)
+    vocab = [f"term{i}".encode() for i in range(40)] + [b"the"] * 4
+    docs = {
+        d: b" ".join(rng.choice(vocab, size=rng.integers(0, 7)).tolist())
+        for d in range(200)
+    }
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    got = build_inverted_index_mesh(
+        list(docs.values()), np.asarray(list(docs.keys()), np.int32),
+        make_mesh(8), cfg,
+    )
+    assert got == py_inverted_index(docs)
+
+
+def test_distributed_inverted_index_skewed_bins_lossless():
+    """Tiny bins force the backlog machinery; postings must stay exact."""
+    from locust_tpu.apps.inverted_index import build_inverted_index_mesh
+    from locust_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(9)
+    vocab = [f"w{i}".encode() for i in range(120)]
+    docs = {d: b" ".join(rng.choice(vocab, size=5).tolist()) for d in range(64)}
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+    got = build_inverted_index_mesh(
+        list(docs.values()), np.asarray(list(docs.keys()), np.int32),
+        make_mesh(8), cfg, skew_factor=0.2,
+    )
+    assert got == py_inverted_index(docs)
+
+
+def test_distributed_inverted_index_capacity_raises():
+    from locust_tpu.apps.inverted_index import build_inverted_index_mesh
+    from locust_tpu.parallel import make_mesh
+
+    vocab = [f"w{i}".encode() for i in range(100)]
+    docs = {d: b" ".join(vocab[d % 50 : d % 50 + 6]) for d in range(64)}
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+    with pytest.raises(ValueError, match="pairs_capacity"):
+        build_inverted_index_mesh(
+            list(docs.values()), np.asarray(list(docs.keys()), np.int32),
+            make_mesh(8), cfg, pairs_capacity=4,
+        )
+
+
 # ---------------------------------------------------------------- sample sort
 
 def test_distributed_sample_sort_random():
