@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto: accelerator if its init probe passes, else CPU; "
                         "cpu: pin CPU and deregister the TPU plugin (immune to "
                         "a wedged tunnel); tpu: require an accelerator")
+    p.add_argument("--trace", action="store_true",
+                   help="print a wall-clock span report (load/run/output) "
+                        "on stderr in addition to the stage report")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax/XLA profiler trace of the run into "
+                        "this directory (view with TensorBoard/XProf)")
     return p
 
 
@@ -112,54 +118,81 @@ def _run(args) -> int:
     eng = MapReduceEngine(cfg)
     inter = args.intermediate or [DEFAULT_INTERMEDIATE]
 
+    # --trace / --profile-dir wire the hardening utils (SURVEY.md §5
+    # tracing): wall-clock spans + optional XLA profiler capture.
+    import contextlib
+
+    from locust_tpu.utils import SpanTimer, device_trace
+
+    timer = SpanTimer()
+    prof = (
+        device_trace(args.profile_dir)
+        if args.profile_dir
+        else contextlib.nullcontext()
+    )
+
     if args.stage in (STAGE_SINGLE, STAGE_MAP):
-        rows = loader.load_rows(
-            args.filename, cfg.line_width, args.line_start, args.line_end
-        )
-        print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
-        if args.checkpoint_dir:
-            res = eng.run_checkpointed(
-                rows, args.checkpoint_dir, every=args.checkpoint_every
-            )
-        elif args.no_timing:
-            res = eng.run_fused(rows)
-        else:
-            res = eng.timed_run(rows)
-        if not args.no_timing:
-            # The reference's per-stage report (README.md:72-88 format).
-            print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)
-            print(f"Process stage: {res.times.process_ms:10.3f} ms", file=sys.stderr)
-            print(f"Reduce stage:  {res.times.reduce_ms:10.3f} ms", file=sys.stderr)
-        if res.truncated:
-            print("[locust] WARN: table capacity exceeded; tail keys dropped",
-                  file=sys.stderr)
-        if args.stage == STAGE_MAP:
-            out = inter[0]
-            serde.write_tsv(res.to_host_pairs(), out)
-            print(f"[locust] node {args.node_num}: intermediate written to {out}",
-                  file=sys.stderr)
-            return 0
-        _print_table(res.to_host_pairs(), args.limit)
+        with prof:
+            with timer.span("load"):
+                rows = loader.load_rows(
+                    args.filename, cfg.line_width, args.line_start, args.line_end
+                )
+            print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
+            with timer.span("run"):
+                # Each run method syncs internally, so the span is accurate.
+                if args.checkpoint_dir:
+                    res = eng.run_checkpointed(
+                        rows, args.checkpoint_dir, every=args.checkpoint_every
+                    )
+                elif args.no_timing:
+                    res = eng.run_fused(rows)
+                else:
+                    res = eng.timed_run(rows)
+            if not args.no_timing:
+                # The reference's per-stage report (README.md:72-88 format).
+                print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)
+                print(f"Process stage: {res.times.process_ms:10.3f} ms", file=sys.stderr)
+                print(f"Reduce stage:  {res.times.reduce_ms:10.3f} ms", file=sys.stderr)
+            if res.truncated:
+                print("[locust] WARN: table capacity exceeded; tail keys dropped",
+                      file=sys.stderr)
+            with timer.span("output"):
+                if args.stage == STAGE_MAP:
+                    out = inter[0]
+                    serde.write_tsv(res.to_host_pairs(), out)
+                    print(f"[locust] node {args.node_num}: intermediate written to {out}",
+                          file=sys.stderr)
+                else:
+                    _print_table(res.to_host_pairs(), args.limit)
+        if args.trace:
+            print(timer.report(), file=sys.stderr)
         return 0
 
     # STAGE_REDUCE: merge intermediate TSVs from map nodes; always re-sort (Q6).
-    key_rows_list, values_list = [], []
-    for path in inter:
-        k, v = serde.read_tsv(path, cfg.key_width)
-        key_rows_list.append(k)
-        values_list.append(v)
-    keys = np.concatenate(key_rows_list) if key_rows_list else np.zeros((0, cfg.key_width), np.uint8)
-    values = np.concatenate(values_list) if values_list else np.zeros((0,), np.int32)
-    print(f"[locust] node {args.node_num}: {keys.shape[0]} intermediate pairs "
-          f"from {len(inter)} file(s)", file=sys.stderr)
-    batch = KVBatch.from_bytes(
-        jnp.asarray(keys), jnp.asarray(values), jnp.ones(keys.shape[0], bool)
-    )
-    from locust_tpu.engine import finalize_host_pairs
-    from locust_tpu.ops import segment_reduce, sort_and_compact
+    with prof:
+        with timer.span("load"):
+            key_rows_list, values_list = [], []
+            for path in inter:
+                k, v = serde.read_tsv(path, cfg.key_width)
+                key_rows_list.append(k)
+                values_list.append(v)
+            keys = np.concatenate(key_rows_list) if key_rows_list else np.zeros((0, cfg.key_width), np.uint8)
+            values = np.concatenate(values_list) if values_list else np.zeros((0,), np.int32)
+        print(f"[locust] node {args.node_num}: {keys.shape[0]} intermediate pairs "
+              f"from {len(inter)} file(s)", file=sys.stderr)
+        batch = KVBatch.from_bytes(
+            jnp.asarray(keys), jnp.asarray(values), jnp.ones(keys.shape[0], bool)
+        )
+        from locust_tpu.engine import finalize_host_pairs
+        from locust_tpu.ops import segment_reduce, sort_and_compact
 
-    table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), eng.combine)
-    _print_table(finalize_host_pairs(table, eng.combine), args.limit)
+        with timer.span("run"):
+            table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), eng.combine)
+            pairs = finalize_host_pairs(table, eng.combine)  # device sync
+        with timer.span("output"):
+            _print_table(pairs, args.limit)
+    if args.trace:
+        print(timer.report(), file=sys.stderr)
     return 0
 
 
