@@ -62,16 +62,18 @@ def error_payload(msg: str) -> dict:
 
 
 def load_corpus(target_bytes: int) -> list[bytes]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    sample = os.path.join(here, "data", "sample_corpus.txt")
     path = "/root/reference/hamlet.txt"
     if os.path.exists(path):
         base = open(path, "rb").read().splitlines()
-    else:  # synthetic fallback corpus with a Zipf-ish vocabulary
-        rng = np.random.default_rng(0)
-        vocab = [f"word{i}".encode() for i in range(5000)] + [b"the"] * 40
-        base = [
-            b" ".join(rng.choice(vocab, size=rng.integers(3, 12)).tolist())
-            for _ in range(4000)
-        ]
+    elif os.path.exists(sample):  # the repo's own shipped corpus
+        base = open(sample, "rb").read().splitlines()
+    else:  # fully synthetic Zipf fallback
+        sys.path.insert(0, here)
+        from locust_tpu.io.corpus import synthetic_corpus
+
+        return synthetic_corpus(target_bytes, n_vocab=30_000)
     lines, total = [], 0
     while total < target_bytes:
         for ln in base:

@@ -54,7 +54,11 @@ class EngineConfig:
     # Bounds the cross-block merge cost (the merge sorts table_size +
     # emits_per_block rows, not 2 x emits_per_block); a corpus with more
     # distinct keys than this reports truncation (RunResult.truncated).
-    # None (default) resolves to min(65536, emits_per_block).
+    # None (default) resolves to min(65536, emits_per_block) — measured the
+    # fastest setting at both 5k and 100k vocabularies
+    # (artifacts/bench_table_size_cpu_r2.jsonl); vocabularies past 2^16
+    # distinct keys must raise it explicitly (tests/test_scale.py pins the
+    # loud-truncation behavior at the default).
     table_size: int | None = None
 
     # Process-stage sort strategy.  "hash": sort by a 64-bit key hash —

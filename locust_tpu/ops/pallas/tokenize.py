@@ -92,6 +92,10 @@ def tokenize_block_pallas(
     num_lines, width = lines.shape
     if num_lines % TILE_LINES != 0:
         raise ValueError(f"block_lines must be a multiple of {TILE_LINES}")
+    if width % 128 != 0:
+        # uint8 tiles are (32, 128): a non-multiple width would misalign
+        # every VMEM block (module docstring constraint, now enforced).
+        raise ValueError(f"line_width must be a multiple of 128, got {width}")
     emits, key_w = cfg.emits_per_line, cfg.key_width
     grid = (num_lines // TILE_LINES,)
 

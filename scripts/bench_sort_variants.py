@@ -4,34 +4,30 @@ Compares (per N rows, 8 key lanes):
   A. lex:    lax.sort with 9 keys (invalid + lanes) + value payload
   B. hash64: lax.sort with 3 keys (invalid, h1, h2) + index payload, gather
              after — using the SHIPPED packing.hash_pair (salted-sum form)
+  C. hash64: same 3 keys but rows ride as sort payloads (no gather)
 
 Checksums force full materialization: on remote-TPU links,
 block_until_ready alone does not reliably block.
+
+Usage: [N=393216] python scripts/bench_sort_variants.py [--backend auto|cpu|tpu]
 """
 
+import argparse
 import os
+import sys
 import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from locust_tpu.core import packing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 N = int(os.environ.get("N", 393216))
 L = 8
 
-rng = np.random.default_rng(0)
-lanes = jnp.asarray(
-    rng.integers(0, 2**32, size=(N, L), dtype=np.uint64).astype(np.uint32)
-)
-values = jnp.asarray(rng.integers(0, 100, size=(N,), dtype=np.int32))
-valid = jnp.asarray(rng.random(N) < 0.6)
-
 
 def variant_a(lanes, values, valid):
+    import jax
+    import jax.numpy as jnp
+
     invalid = (~valid).astype(jnp.uint32)
     operands = (invalid, *(lanes[:, i] for i in range(L)), values)
     out = jax.lax.sort(operands, num_keys=1 + L)
@@ -39,6 +35,11 @@ def variant_a(lanes, values, valid):
 
 
 def variant_b(lanes, values, valid):
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
     invalid = (~valid).astype(jnp.uint32)
     h1, h2 = packing.hash_pair(lanes)
     idx = jnp.arange(N, dtype=jnp.int32)
@@ -46,7 +47,25 @@ def variant_b(lanes, values, valid):
     return jnp.sum(lanes[sidx, 0]) + jnp.sum(values[sidx].astype(jnp.uint32))
 
 
+def variant_c(lanes, values, valid):
+    """hash keys, but rows ride as sort PAYLOADS (no post-sort gather)."""
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    invalid = (~valid).astype(jnp.uint32)
+    h1, h2 = packing.hash_pair(lanes)
+    out = jax.lax.sort(
+        (invalid, h1, h2, *(lanes[:, i] for i in range(L)), values),
+        num_keys=3,
+    )
+    return jnp.sum(out[3]) + jnp.sum(out[-1].astype(jnp.uint32))
+
+
 def timeit(fn, *args, reps=5):
+    import jax
+
     f = jax.jit(fn)
     t0 = time.perf_counter()
     float(f(*args))
@@ -59,6 +78,35 @@ def timeit(fn, *args, reps=5):
     return compile_s, best * 1e3
 
 
-for name, fn in [("A_lex9", variant_a), ("B_hash3", variant_b)]:
-    c, ms = timeit(fn, lanes, values, valid)
-    print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}")
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    args = ap.parse_args()
+
+    from locust_tpu.backend import select_backend
+
+    select_backend(args.backend)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    lanes = jnp.asarray(
+        rng.integers(0, 2**32, size=(N, L), dtype=np.uint64).astype(np.uint32)
+    )
+    values = jnp.asarray(rng.integers(0, 100, size=(N,), dtype=np.int32))
+    valid = jnp.asarray(rng.random(N) < 0.6)
+
+    print(f"backend={jax.default_backend()} N={N} L={L}", flush=True)
+    for name, fn in [
+        ("A_lex9", variant_a),
+        ("B_hash3_gather", variant_b),
+        ("C_hash3_payload", variant_c),
+    ]:
+        c, ms = timeit(fn, lanes, values, valid)
+        print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

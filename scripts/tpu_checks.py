@@ -1,0 +1,87 @@
+"""Real-TPU validation battery (VERDICT.md round-1 #4/#10).
+
+Run on hardware (the suite pins CPU):
+
+    python scripts/tpu_checks.py
+
+1. Compiles + executes the Pallas tokenizer kernel (interpret=False).
+2. A/B times the Pallas vs jnp Map stage at bench shapes.
+3. Prints one JSON line per check; artifact-friendly.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+def main() -> int:
+    from locust_tpu.backend import select_backend
+
+    select_backend("tpu", probe_timeout_s=240, retries=2)
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.ops.map_stage import tokenize_block
+    from locust_tpu.ops.pallas.tokenize import tokenize_block_pallas
+
+    print(json.dumps({"check": "backend", "platform": jax.default_backend()}))
+
+    cfg = EngineConfig(block_lines=4096, line_width=128)
+    # Same corpus fallback chain as bench.py: hamlet -> shipped sample.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    text = bench.load_corpus(256 * 1024)
+    lines = (text * (cfg.block_lines // len(text) + 1))[: cfg.block_lines]
+    rows = jnp.asarray(bytes_ops.strings_to_rows(lines, cfg.line_width))
+
+    # 1. Pallas kernel compiles + runs for real, and matches the jnp path.
+    jit_tokenize = jax.jit(tokenize_block, static_argnames=("cfg",))
+    t0 = time.perf_counter()
+    pk, pv, povf = tokenize_block_pallas(rows, cfg, interpret=False)
+    jax.block_until_ready(pk)
+    compile_s = time.perf_counter() - t0
+    ref = jit_tokenize(rows, cfg=cfg)
+    match = bool(
+        jnp.array_equal(pk, ref.keys)
+        and jnp.array_equal(pv, ref.valid)
+        and int(povf) == int(ref.overflow)
+    )
+    print(json.dumps({
+        "check": "pallas_tokenizer_tpu",
+        "compile_s": round(compile_s, 1),
+        "matches_jnp": match,
+    }), flush=True)
+
+    # 2. A/B: pallas vs jnp map stage steady-state.
+    def best_ms(fn, reps=5):
+        fn()  # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    # Both sides jitted: the engine runs the jnp tokenizer under jit, so an
+    # eager jnp side would overstate the Pallas win.
+    jnp_ms = best_ms(lambda: jit_tokenize(rows, cfg=cfg).keys)
+    pal_ms = best_ms(
+        lambda: tokenize_block_pallas(rows, cfg, interpret=False)[0]
+    )
+    print(json.dumps({
+        "check": "map_ab",
+        "jnp_ms": round(jnp_ms, 3),
+        "pallas_ms": round(pal_ms, 3),
+        "pallas_speedup": round(jnp_ms / pal_ms, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

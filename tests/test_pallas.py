@@ -1,6 +1,8 @@
 """Pallas kernel parity tests (interpret mode on CPU — SURVEY.md §5
 "our analog is ... interpret-mode Pallas tests")."""
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -79,3 +81,30 @@ def test_pallas_tokenizer_rejects_bad_tile():
     rows = jnp.zeros((cfg.block_lines, 128), jnp.uint8)
     with pytest.raises(ValueError, match="multiple"):
         tokenize_block_pallas(rows, cfg, interpret=True)
+
+
+def test_pallas_tokenizer_rejects_bad_width():
+    cfg = EngineConfig(block_lines=TILE_LINES, line_width=96,
+                       emits_per_line=4, key_width=16)
+    rows = jnp.zeros((cfg.block_lines, 96), jnp.uint8)
+    with pytest.raises(ValueError, match="128"):
+        tokenize_block_pallas(rows, cfg, interpret=True)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("LOCUST_TPU_TESTS"),
+    reason="real-TPU compile check; suite pins the CPU backend "
+    "(run scripts/tpu_checks.py on hardware)",
+)
+def test_pallas_tokenizer_compiles_on_tpu():
+    """VERDICT.md round-1 #10: prove the kernel lowers on REAL TPU, not
+    just interpret mode.  Gated on LOCUST_TPU_TESTS because conftest pins
+    this suite to the CPU backend."""
+    import jax
+
+    assert jax.default_backend() not in ("cpu",), "needs an accelerator"
+    cfg = EngineConfig(block_lines=TILE_LINES, line_width=128,
+                       emits_per_line=4, key_width=16)
+    rows = jnp.zeros((cfg.block_lines, 128), jnp.uint8)
+    keys, valid, ovf = tokenize_block_pallas(rows, cfg, interpret=False)
+    assert keys.shape == (TILE_LINES, 4, 16) and int(ovf) == 0
