@@ -1,2 +1,3 @@
+from locust_tpu.utils.artifacts import on_tpu, record  # noqa: F401
 from locust_tpu.utils.checks import checkify_pipeline, validate_batch  # noqa: F401
 from locust_tpu.utils.profiling import SpanTimer, device_trace  # noqa: F401
