@@ -35,7 +35,13 @@ import sys
 import threading
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+# Repo-local persistent compilation cache, pre-seeded by any earlier TPU
+# session (committed under .jax_cache/): a fresh driver environment reuses
+# compiled executables, so a short tunnel-up window suffices end-to-end.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_HERE, ".jax_cache")
+)
 
 import numpy as np
 
@@ -44,6 +50,9 @@ TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_BYTES", 32 * 1024 * 1024))
 CPU_TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_CPU_BYTES", 8 * 1024 * 1024))
 BLOCK_LINES = int(os.environ.get("LOCUST_BENCH_BLOCK_LINES", 32768))
 TIMEOUT_S = float(os.environ.get("LOCUST_BENCH_TIMEOUT", 1200))
+# Wall-clock reserved for the final CPU fallback when the retry loop gives
+# up on the TPU (compile+run of the CPU-sized corpus fits comfortably).
+CPU_RESERVE_S = float(os.environ.get("LOCUST_BENCH_CPU_RESERVE", 420))
 
 
 def emit(payload: dict) -> None:
@@ -63,6 +72,16 @@ def error_payload(msg: str) -> dict:
 
 def load_corpus(target_bytes: int) -> list[bytes]:
     here = os.path.dirname(os.path.abspath(__file__))
+    # Realism knob (VERDICT r2 weak #7): replicated hamlet has only ~5.6k
+    # distinct words, which stresses neither the 65,536-row table nor skew
+    # handling.  LOCUST_BENCH_VOCAB=<n> switches to the Zipf generator at
+    # that vocabulary, making the headline number harder to game.
+    vocab = int(os.environ.get("LOCUST_BENCH_VOCAB", 0))
+    if vocab > 0:
+        sys.path.insert(0, here)
+        from locust_tpu.io.corpus import synthetic_corpus
+
+        return synthetic_corpus(target_bytes, n_vocab=vocab)
     sample = os.path.join(here, "data", "sample_corpus.txt")
     path = "/root/reference/hamlet.txt"
     if os.path.exists(path):
@@ -120,13 +139,33 @@ def run_bench(backend: str) -> dict:
         f"distinct={res.num_segments}, truncated={res.truncated}",
         file=sys.stderr,
     )
-    return {
+    payload = {
         "metric": "wordcount_throughput",
         "value": round(mb_s, 3),
         "unit": "MB/s",
         "vs_baseline": round(mb_s / BASELINE_MB_S, 2),
         "backend": jax.default_backend(),
+        "distinct": res.num_segments,
+        "truncated": res.truncated,
     }
+    # Opportunistic TPU evidence (VERDICT r2 #1): every TPU bench run leaves
+    # a committed-able row in artifacts/tpu_runs.jsonl, independent of
+    # whether the driver captures this process's stdout.
+    from locust_tpu.utils import artifacts
+
+    artifacts.record(
+        "bench",
+        {
+            **payload,
+            "corpus_mb": round(corpus_bytes / 1e6, 1),
+            "lines": len(lines),
+            "block_lines": BLOCK_LINES,
+            "best_s": round(best, 4),
+            "distinct": res.num_segments,
+            "truncated": res.truncated,
+        },
+    )
+    return payload
 
 
 def rerun_on_cpu(reason: str, budget_s: float) -> int:
@@ -157,9 +196,7 @@ def rerun_on_cpu(reason: str, budget_s: float) -> int:
     except subprocess.TimeoutExpired:
         emit(error_payload(f"TPU run failed ({reason}); CPU rerun timed out"))
         return 1
-    json_lines = [
-        ln for ln in proc.stdout.splitlines() if ln.strip().startswith("{")
-    ]
+    json_lines = _json_lines(proc.stdout)
     if not json_lines:
         emit(error_payload(
             f"TPU run failed ({reason}); CPU rerun rc={proc.returncode} "
@@ -170,7 +207,116 @@ def rerun_on_cpu(reason: str, budget_s: float) -> int:
     return proc.returncode
 
 
+def _json_lines(stdout: str) -> list[str]:
+    return [ln for ln in stdout.splitlines() if ln.strip().startswith("{")]
+
+
+def orchestrate() -> int:
+    """Outer retry-until-deadline loop (VERDICT r2 missing #1).
+
+    The TPU tunnel flaps on minute timescales: a single up-front probe
+    (even with retries) misses a window that opens two minutes later.  So
+    in auto mode the bench repeatedly attempts a TPU run in a CHILD
+    process — each attempt is internally probed/watchdogged and cannot
+    hang — until one succeeds or only the CPU-fallback reserve remains.
+    Child processes re-probe naturally as the backend.py fail-marker
+    (120s TTL) expires.  With the pre-seeded compilation cache a single
+    ~3-minute tunnel-up window fits probe + compile + steady-state runs.
+    """
+    deadline = time.monotonic() + TIMEOUT_S
+    attempt = 0
+    while True:
+        budget = deadline - time.monotonic() - CPU_RESERVE_S
+        if budget < 150:
+            break
+        attempt += 1
+        env = dict(os.environ)
+        env["LOCUST_BENCH_INNER"] = "1"
+        env["LOCUST_BENCH_BACKEND"] = "tpu"
+        env["LOCUST_BENCH_TIMEOUT"] = str(max(120.0, budget))
+        # The child must FAIL FAST on a mid-run TPU death, not burn this
+        # attempt's whole budget on its own CPU rerun — the orchestrator
+        # owns the CPU fallback.
+        env["LOCUST_BENCH_NO_CPU_RERUN"] = "1"
+        env.setdefault("LOCUST_BENCH_PROBE_TIMEOUT", "90")
+        env.setdefault("LOCUST_BENCH_PROBE_RETRIES", "1")
+        print(
+            f"[bench] orchestrator: TPU attempt {attempt} "
+            f"(budget {budget:.0f}s)",
+            file=sys.stderr,
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                timeout=budget + 30,
+                stdout=subprocess.PIPE,
+                stderr=sys.stderr,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        lines = _json_lines(proc.stdout)
+        if proc.returncode == 0 and lines:
+            try:
+                row = json.loads(lines[-1])
+            except ValueError:
+                row = {}
+            if row.get("backend") == "tpu" and "error" not in row:
+                print(lines[-1], flush=True)
+                return 0
+        print(
+            f"[bench] orchestrator: attempt {attempt} failed "
+            f"(rc={proc.returncode}); will retry",
+            file=sys.stderr,
+        )
+        time.sleep(
+            min(30.0, max(0.0, deadline - CPU_RESERVE_S - time.monotonic()))
+        )
+
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        emit(error_payload("orchestrator: no budget left for CPU fallback"))
+        return 1
+    print(
+        f"[bench] orchestrator: TPU attempts exhausted; CPU fallback "
+        f"({remaining:.0f}s)",
+        file=sys.stderr,
+    )
+    env = dict(os.environ)
+    env["LOCUST_BENCH_INNER"] = "1"
+    env["LOCUST_BENCH_BACKEND"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LOCUST_BENCH_TIMEOUT"] = str(remaining)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=remaining + 30,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        emit(error_payload("orchestrator: CPU fallback timed out"))
+        return 1
+    lines = _json_lines(proc.stdout)
+    if not lines:
+        emit(error_payload(
+            f"orchestrator: CPU fallback rc={proc.returncode} printed no JSON"
+        ))
+        return 1
+    print(lines[-1], flush=True)
+    return proc.returncode
+
+
 def main() -> int:
+    if (
+        os.environ.get("LOCUST_BENCH_BACKEND", "auto") == "auto"
+        and not os.environ.get("LOCUST_BENCH_INNER")
+        and os.environ.get("JAX_PLATFORMS", "").strip() != "cpu"
+    ):
+        return orchestrate()
     deadline = time.monotonic() + TIMEOUT_S
     watchdog = threading.Timer(
         TIMEOUT_S,
@@ -199,7 +345,7 @@ def main() -> int:
     try:
         payload = run_bench(backend)
     except Exception as e:  # noqa: BLE001 - the driver needs its JSON line
-        if backend == "tpu":
+        if backend == "tpu" and not os.environ.get("LOCUST_BENCH_NO_CPU_RERUN"):
             watchdog.cancel()
             return rerun_on_cpu(
                 f"{type(e).__name__}: {e}", deadline - time.monotonic()

@@ -44,14 +44,30 @@ logger = logging.getLogger("locust_tpu")
 # factory breaks later mlir platform registration (checkify import).
 _BUILTIN_FACTORIES = ("cpu", "interpreter", "tpu", "cuda", "rocm", "gpu", "metal")
 
-# Probe results are cached in uid-scoped timestamp markers so back-to-back
-# invocations (CLI runs, distributor workers, bench retries) neither pay a
-# duplicate child-process backend init (tens of seconds on a remote tunnel)
-# nor re-probe a known-down tunnel (minutes of retry budget per run).
-_uid = os.getuid() if hasattr(os, "getuid") else 0
-_PROBE_OK_MARKER = f"/tmp/locust_tpu_probe_ok.{_uid}"
+# Probe results are cached in timestamp markers so back-to-back invocations
+# (CLI runs, distributor workers, bench retries) neither pay a duplicate
+# child-process backend init (tens of seconds on a remote tunnel) nor
+# re-probe a known-down tunnel (minutes of retry budget per run).  Markers
+# live in a 0700 per-user cache dir, not world-shared /tmp, so another
+# local user can neither pre-create them to poison probe results nor plant
+# a symlink for _touch to follow (ADVICE r2, low #2).
+
+
+def _marker_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = os.path.join(base, "locust_tpu")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+    except OSError:  # pragma: no cover - markers are best-effort
+        pass
+    return d
+
+
+_PROBE_OK_MARKER = os.path.join(_marker_dir(), "probe_ok")
 _PROBE_OK_TTL_S = 300.0
-_PROBE_FAIL_MARKER = f"/tmp/locust_tpu_probe_fail.{_uid}"
+_PROBE_FAIL_MARKER = os.path.join(_marker_dir(), "probe_fail")
 _PROBE_FAIL_TTL_S = 120.0
 
 _PROBE_SRC = (
@@ -144,6 +160,10 @@ def probe_tpu(
             if platform != "cpu":
                 _touch(_PROBE_OK_MARKER, platform)
                 return True, f"{platform} backend up ({dt:.1f}s init)"
+            # Cache the negative result too: on a CPU-only host every
+            # auto-mode run would otherwise re-pay a full subprocess jax
+            # init per invocation (ADVICE r2, low #3).
+            _touch(_PROBE_FAIL_MARKER, "only the CPU backend is available")
             return False, "only the CPU backend is available"
         tail = (proc.stderr or proc.stdout).strip().splitlines()
         detail = f"attempt {attempt + 1}: rc={proc.returncode} {tail[-1] if tail else ''}"

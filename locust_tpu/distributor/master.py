@@ -10,9 +10,19 @@ MapReduce program for all nodes" over a cluster file of ``ip port`` lines
      ``[line_start, line_end)`` CLI contract (main.cu:369-374),
   3. fan the staged map out to all workers in parallel,
   4. collect each node's intermediate TSV over the authenticated channel
-     (the transport step missing from the reference, SURVEY.md §3.2),
+     (the transport step missing from the reference, SURVEY.md §3.2) —
+     streamed in bounded offset-addressed chunks, so intermediates larger
+     than one protocol frame round-trip fine,
   5. run the reduce stage locally over all collected TSVs — which re-sorts,
      fixing the reference's unsorted-reduce-input bug (Q6).
+
+Fault tolerance (VERDICT r2 missing #6 — the reference has none, its slave
+ACKs unconditionally, slave.py:19-20): a shard whose worker fails (dead
+connection, timeout, non-zero map exit) is REASSIGNED to the next live
+worker, bounded by ``max_retries``; a worker that failed is quarantined
+for the rest of the job.  Line-range shards are deterministic and
+idempotent (same [start, end) slice on any node produces the same TSV), so
+re-running a shard elsewhere is always safe.
 """
 
 from __future__ import annotations
@@ -20,13 +30,18 @@ from __future__ import annotations
 import argparse
 import base64
 import concurrent.futures
+import logging
 import os
 import socket
 import sys
 import tempfile
+import threading
 import uuid
 
 from locust_tpu.distributor import protocol
+from locust_tpu.io.loader import count_lines
+
+logger = logging.getLogger("locust_tpu")
 
 
 class MasterError(RuntimeError):
@@ -39,19 +54,6 @@ def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.
         return protocol.recv_frame(sock, secret)
 
 
-def count_lines(path: str) -> int:
-    """Streaming line count (O(1) memory; multi-GB corpora are fine)."""
-    n = 0
-    last = b"\n"
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            n += chunk.count(b"\n")
-            last = chunk[-1:]
-    if last != b"\n":
-        n += 1  # trailing fragment counts (Q1 semantics)
-    return n
-
-
 def run_job(
     cluster: list[tuple[str, int]],
     input_file: str,
@@ -59,8 +61,13 @@ def run_job(
     workdir: str | None = None,
     extra_args: list[str] | None = None,
     rpc=_rpc,
+    max_retries: int = 2,
 ) -> list[str]:
-    """Fan out map stages, collect TSVs; returns local TSV paths for reduce."""
+    """Fan out map stages, collect TSVs; returns local TSV paths for reduce.
+
+    Each of the ``len(cluster)`` line-range shards is tried on up to
+    ``max_retries + 1`` distinct live workers before the job fails.
+    """
     n = len(cluster)
     total = count_lines(input_file)
     per = -(-total // n) if total else 1
@@ -69,11 +76,32 @@ def run_job(
     # Unique per-job intermediate names: concurrent jobs against the same
     # worker pool must not clobber each other's TSVs.
     job_id = uuid.uuid4().hex[:12]
+    dead: set[int] = set()
+    dead_lock = threading.Lock()
 
-    def one(i_node):
-        i, node = i_node
-        start, end = i * per, min((i + 1) * per, total)
-        inter = f"/tmp/locust_{job_id}_node{i}.tsv"
+    def fetch_chunked(node, remote: str, local: str) -> None:
+        offset = 0
+        with open(local, "wb") as f:
+            while True:
+                got = rpc(
+                    node,
+                    {"cmd": "fetch", "path": remote, "offset": offset},
+                    secret,
+                )
+                if got.get("status") != "ok":
+                    raise MasterError(
+                        f"fetch failed on node {node}: {got.get('error')}"
+                    )
+                data = base64.b64decode(got["data_b64"])
+                f.write(data)
+                offset += len(data)
+                if got.get("eof", True) or not data:
+                    return
+
+    def try_shard(shard: int, node_idx: int) -> str:
+        node = cluster[node_idx]
+        start, end = shard * per, min((shard + 1) * per, total)
+        inter = f"/tmp/locust_{job_id}_node{shard}.tsv"
         resp = rpc(
             node,
             {
@@ -81,7 +109,7 @@ def run_job(
                 "file": input_file,
                 "line_start": start,
                 "line_end": end,
-                "node_num": i,
+                "node_num": shard,
                 "intermediate": inter,
                 "extra_args": extra_args or [],
             },
@@ -92,16 +120,46 @@ def run_job(
                 f"map failed on node {node}: rc={resp.get('returncode')} "
                 f"err={resp.get('error', '')}\n{resp.get('log', '')}"
             )
-        fetched = rpc(node, {"cmd": "fetch", "path": inter}, secret)
-        if fetched.get("status") != "ok":
-            raise MasterError(f"fetch failed on node {node}: {fetched.get('error')}")
-        local = os.path.join(workdir, f"node{i}.tsv")
-        with open(local, "wb") as f:
-            f.write(base64.b64decode(fetched["data_b64"]))
+        local = os.path.join(workdir, f"node{shard}.tsv")
+        fetch_chunked(node, inter, local)
         return local
 
+    def one(shard: int) -> str:
+        last_err: Exception | None = None
+        tried: set[int] = set()
+        for _ in range(max_retries + 1):
+            with dead_lock:
+                # Prefer the shard's home node, then rotate; skip workers
+                # already dead or already tried for this shard.
+                alive = [
+                    (shard + k) % n
+                    for k in range(n)
+                    if (shard + k) % n not in dead
+                    and (shard + k) % n not in tried
+                ]
+            if not alive:
+                break
+            node_idx = alive[0]
+            tried.add(node_idx)
+            try:
+                return try_shard(shard, node_idx)
+            except (MasterError, OSError) as e:
+                last_err = e
+                with dead_lock:
+                    dead.add(node_idx)
+                logger.warning(
+                    "shard %d failed on worker %d (%s); reassigning",
+                    shard,
+                    node_idx,
+                    e,
+                )
+        raise MasterError(
+            f"shard {shard} failed on every tried worker "
+            f"(max_retries={max_retries}): {last_err}"
+        )
+
     with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
-        return list(ex.map(one, enumerate(cluster)))
+        return list(ex.map(one, range(n)))
 
 
 def main(argv=None) -> int:

@@ -126,17 +126,36 @@ class Worker:
                 return self.map_runner(req)
             except Exception as e:  # propagate failure, don't fake-ACK
                 return {"status": "error", "error": repr(e)}
-        # fetch: stream back an intermediate file this worker produced.
+        # fetch: stream back an intermediate file this worker produced, one
+        # bounded window per request so arbitrarily large TSVs fit the
+        # frame limit (the master loops on ``offset`` until ``eof``).
         # Containment boundary = self.workdir (server config, NOT the request).
         path = req.get("path", "")
         real = os.path.realpath(path)
         if not real.startswith(self.workdir + os.sep):
             return {"status": "error", "error": "path outside workdir"}
         try:
-            data = open(real, "rb").read()
+            offset = int(req.get("offset", 0))
+            max_bytes = int(req.get("max_bytes", protocol.FETCH_CHUNK))
+        except (TypeError, ValueError):
+            return {"status": "error", "error": "bad offset/max_bytes"}
+        if offset < 0:
+            return {"status": "error", "error": "negative offset"}
+        max_bytes = max(1, min(max_bytes, protocol.FETCH_CHUNK_MAX))
+        try:
+            size = os.path.getsize(real)
+            with open(real, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
         except OSError as e:
             return {"status": "error", "error": str(e)}
-        return {"status": "ok", "data_b64": base64.b64encode(data).decode()}
+        return {
+            "status": "ok",
+            "data_b64": base64.b64encode(data).decode(),
+            "offset": offset,
+            "total": size,
+            "eof": offset + len(data) >= size,
+        }
 
 
 def main(argv=None) -> int:

@@ -54,6 +54,17 @@ def _load() -> ctypes.CDLL:
                 ctypes.c_long,
                 ctypes.c_long,
             ]
+            lib.ingest_load_window.restype = ctypes.c_long
+            lib.ingest_load_window.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+            ]
             _lib = lib
     return _lib
 
@@ -88,3 +99,34 @@ def load_rows(
     if wrote < 0:
         raise OSError(f"native ingest failed to read {path!r}")
     return out[:wrote] if wrote < n_rows else out
+
+
+def iter_blocks(
+    path: str,
+    line_width: int,
+    block_lines: int,
+    line_start: int = -1,
+    line_end: int = -1,
+):
+    """Yield ``[<=block_lines, line_width]`` row blocks via the native
+    windowed scanner (bounded memory; see ingest.cpp ingest_load_window)."""
+    lib = _load()
+    offset = ctypes.c_long(0)
+    line_no = ctypes.c_long(0)
+    while True:
+        out = np.zeros((block_lines, line_width), dtype=np.uint8)
+        wrote = lib.ingest_load_window(
+            str(path).encode(),
+            ctypes.byref(offset),
+            ctypes.byref(line_no),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            block_lines,
+            line_width,
+            line_start,
+            line_end,
+        )
+        if wrote < 0:
+            raise OSError(f"native ingest failed to read {path!r}")
+        if wrote == 0:
+            return
+        yield out[:wrote] if wrote < block_lines else out

@@ -48,21 +48,34 @@ def validate_batch(batch: KVBatch, expect_sorted: bool = False, expect_compact: 
         if valid.any():
             last_valid = np.max(np.nonzero(valid)[0])
             assert valid[: last_valid + 1].all(), "valid rows not a prefix"
+    # Vectorized throughout (VERDICT r2 weak #4): Python per-row loops made
+    # LOCUST_DEBUG_CHECKS cost seconds on a 65k-row table; these numpy row
+    # ops keep it in the low milliseconds, same assertions.
     if expect_sorted:
         live = lanes[valid]
-        # Lexicographic over lanes == row-wise tuple order.
-        for i in range(1, live.shape[0]):
-            a, b = live[i - 1], live[i]
-            assert tuple(a) <= tuple(b), f"rows {i-1},{i} out of order"
-    # Keys must be NUL-padded: no nonzero byte after the first NUL.
+        if live.shape[0] > 1:
+            a, b = live[:-1], live[1:]
+            # Row-wise lexicographic a <= b over big-endian lanes: decide at
+            # the first differing lane (all-equal rows pass trivially).
+            neq = a != b
+            any_diff = neq.any(axis=1)
+            first = np.argmax(neq, axis=1)
+            r = np.arange(a.shape[0])
+            ok = ~any_diff | (a[r, first] < b[r, first])
+            bad = np.nonzero(~ok)[0]
+            assert bad.size == 0, f"rows {bad[0]},{bad[0]+1} out of order"
+    # Keys must be NUL-padded: no nonzero byte after the first NUL.  A row
+    # passes iff bytes are monotone in "zero-ness": once a NUL appears, all
+    # later bytes are NUL == the nonzero mask never rises after falling.
     from locust_tpu.core.packing import unpack_keys
     import jax.numpy as jnp
 
     kb = np.asarray(jax.device_get(unpack_keys(jnp.asarray(lanes[valid]))))
-    for r, row in enumerate(kb):
-        nz = np.nonzero(row)[0]
-        if nz.size:
-            first_nul = np.argmax(row == 0) if (row == 0).any() else row.size
-            assert nz.max() < first_nul or first_nul == row.size, (
-                f"row {r} has bytes after NUL (interior NUL key)"
-            )
+    if kb.size:
+        nonzero = kb != 0
+        rises = (~nonzero[:, :-1]) & nonzero[:, 1:]
+        bad = np.nonzero(rises.any(axis=1))[0]
+        assert bad.size == 0, (
+            f"row {bad[0] if bad.size else '?'} has bytes after NUL "
+            "(interior NUL key)"
+        )

@@ -99,4 +99,90 @@ long ingest_load_rows(const char* path, unsigned char* out, long max_lines,
   return row;
 }
 
+// Streaming window scan: resume at byte *inout_offset / line *inout_line,
+// fill out[max_lines][width] (NUL-padded, '\r' stripped, truncated to
+// width), honoring the [line_start, line_end) slice.  Advances the two
+// cursors to the exact resume point (always a line boundary) and returns
+// rows written — 0 means EOF or slice end.  Unlike ingest_load_rows, the
+// file is NEVER materialized: one fixed 1MB read buffer regardless of
+// file or line length (a line longer than the buffer keeps only its first
+// `width` bytes while the remainder streams past), which is what lets the
+// 1GB+ north-star corpus (BASELINE.json) run in bounded RSS.
+long ingest_load_window(const char* path, long* inout_offset,
+                        long* inout_line, unsigned char* out, long max_lines,
+                        long width, long line_start, long line_end) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (std::fseek(f, *inout_offset, SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  const long start = line_start < 0 ? 0 : line_start;
+  const long end = line_end;  // < 0 = unbounded
+  long line = *inout_line;
+  long row = 0;
+  long consumed = 0;  // bytes folded into COMPLETED (or EOF-final) lines
+  long linelen = 0;   // bytes seen of the in-progress line
+  std::memset(out, 0, static_cast<size_t>(max_lines) * width);
+
+  const long B = 1 << 20;
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(B));
+  if (!buf) {
+    std::fclose(f);
+    return -1;
+  }
+  bool done = false;
+  bool in_line = false;
+  while (!done) {
+    long got = static_cast<long>(std::fread(buf, 1, B, f));
+    if (got <= 0) break;  // EOF
+    for (long i = 0; i < got; ++i) {
+      const bool want = line >= start && (end < 0 || line < end);
+      if (end >= 0 && line >= end) {
+        done = true;
+        break;
+      }
+      if (!in_line && want && row >= max_lines) {
+        done = true;  // capacity reached at a line boundary: resume here
+        break;
+      }
+      const unsigned char c = buf[i];
+      ++consumed;
+      if (c == '\n') {
+        if (want) {
+          long len = linelen < width ? linelen : width;
+          // Strip the CRLF '\r' only when it actually is the line's last
+          // byte; at a truncated position (linelen > width) it is data.
+          if (linelen <= width && len > 0 &&
+              out[row * width + len - 1] == '\r')
+            out[row * width + len - 1] = 0;
+          ++row;
+        }
+        ++line;
+        linelen = 0;
+        in_line = false;
+      } else {
+        in_line = true;
+        if (want && linelen < width) out[row * width + linelen] = c;
+        ++linelen;
+      }
+    }
+  }
+  if (in_line && !done) {  // trailing fragment without '\n' (Q1 fix)
+    const bool want = line >= start && (end < 0 || line < end);
+    if (want && row < max_lines) {
+      long len = linelen < width ? linelen : width;
+      if (linelen <= width && len > 0 && out[row * width + len - 1] == '\r')
+        out[row * width + len - 1] = 0;
+      ++row;
+    }
+    ++line;
+  }
+  std::free(buf);
+  std::fclose(f);
+  *inout_offset += consumed;
+  *inout_line = line;
+  return row;
+}
+
 }  // extern "C"

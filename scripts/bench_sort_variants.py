@@ -63,6 +63,50 @@ def variant_c(lanes, values, valid):
     return jnp.sum(out[3]) + jnp.sum(out[-1].astype(jnp.uint32))
 
 
+def variant_d(lanes, values, valid):
+    """ONE 32-bit sort key: 31-bit hash, validity in the top bit; gather.
+
+    Collisions between distinct keys rise to ~n^2/2^31 per sort, but the
+    engine's segment reduce compares full key lanes at boundaries, so a
+    collision only duplicates a table row (re-merged on the next fold or
+    in the host finalize) — same safety argument as the 64-bit hash mode
+    at ~2x the sort-key bandwidth savings.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    h1, _ = packing.hash_pair(lanes)
+    key = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    idx = jnp.arange(N, dtype=jnp.int32)
+    _, sidx = jax.lax.sort((key, idx), num_keys=1)
+    return jnp.sum(lanes[sidx, 0]) + jnp.sum(values[sidx].astype(jnp.uint32))
+
+
+def variant_e(lanes, values, valid):
+    """LSD radix sort (pure XLA): 4x8-bit counting passes over the 32-bit
+    folded key — an O(n) alternative to lax.sort's comparison network."""
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+    from locust_tpu.ops.radix_sort import radix_argsort
+
+    h1, _ = packing.hash_pair(lanes)
+    key = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    sidx = radix_argsort(key)
+    return jnp.sum(lanes[sidx, 0]) + jnp.sum(values[sidx].astype(jnp.uint32))
+
+
+VARIANTS = [
+    ("A_lex9", variant_a),
+    ("B_hash3_gather", variant_b),
+    ("C_hash3_payload", variant_c),
+    ("D_hash1_gather", variant_d),
+    ("E_radix4x8", variant_e),
+]
+
+
 def timeit(fn, *args, reps=5):
     import jax
 
@@ -97,14 +141,28 @@ def main() -> int:
     values = jnp.asarray(rng.integers(0, 100, size=(N,), dtype=np.int32))
     valid = jnp.asarray(rng.random(N) < 0.6)
 
+    from locust_tpu.utils import artifacts
+
     print(f"backend={jax.default_backend()} N={N} L={L}", flush=True)
-    for name, fn in [
-        ("A_lex9", variant_a),
-        ("B_hash3_gather", variant_b),
-        ("C_hash3_payload", variant_c),
-    ]:
+    results = {}
+    # LOCUST_SORT_VARIANTS=B,D,E runs a subset (A_lex9's 9-operand sort
+    # takes minutes of XLA compile at bench shapes on TPU; skip it when
+    # the tunnel-up window is short).
+    sel = os.environ.get("LOCUST_SORT_VARIANTS")
+    chosen = [
+        (name, fn)
+        for name, fn in VARIANTS
+        if sel is None or name.split("_")[0] in sel.upper().split(",")
+    ]
+    for name, fn in chosen:
         c, ms = timeit(fn, lanes, values, valid)
+        results[name] = {"compile_s": round(c, 1), "run_ms": round(ms, 3)}
         print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}", flush=True)
+    artifacts.record(
+        "sort_variants",
+        {"n_rows": N, "key_lanes": L, "variants": results},
+        force=bool(os.environ.get("LOCUST_ARTIFACT_FORCE")),
+    )
     return 0
 
 

@@ -52,11 +52,15 @@ def main() -> int:
         and jnp.array_equal(pv, ref.valid)
         and int(povf) == int(ref.overflow)
     )
-    print(json.dumps({
+    from locust_tpu.utils import artifacts
+
+    row = {
         "check": "pallas_tokenizer_tpu",
         "compile_s": round(compile_s, 1),
         "matches_jnp": match,
-    }), flush=True)
+    }
+    print(json.dumps(row), flush=True)
+    artifacts.record("tpu_check", row)
 
     # 2. A/B: pallas vs jnp map stage steady-state.
     def best_ms(fn, reps=5):
@@ -74,12 +78,16 @@ def main() -> int:
     pal_ms = best_ms(
         lambda: tokenize_block_pallas(rows, cfg, interpret=False)[0]
     )
-    print(json.dumps({
+    row = {
         "check": "map_ab",
+        "block_lines": cfg.block_lines,
+        "line_width": cfg.line_width,
         "jnp_ms": round(jnp_ms, 3),
         "pallas_ms": round(pal_ms, 3),
         "pallas_speedup": round(jnp_ms / pal_ms, 2),
-    }), flush=True)
+    }
+    print(json.dumps(row), flush=True)
+    artifacts.record("tpu_check", row)
     return 0
 
 

@@ -101,3 +101,112 @@ def test_npz_roundtrip(tmp_path):
     serde.write_npz(batch, path)
     back = serde.read_npz(path)
     assert back.to_host_pairs() == [(b"alpha", 1), (b"beta", 2)]
+
+
+# ---------------------------------------------------------- streaming ingest
+
+class TestStreamingCorpus:
+    """StreamingCorpus (both backends) must match load_rows exactly."""
+
+    def _assert_stream_matches(self, path, width, block_lines, start=-1,
+                               end=-1, use_native=False):
+        sc = loader.StreamingCorpus(
+            path, width, block_lines, start, end,
+            chunk_bytes=1 << 16, use_native=use_native,
+        )
+        blocks = list(sc)
+        got = (
+            np.concatenate(blocks)
+            if blocks
+            else np.zeros((0, width), np.uint8)
+        )
+        want = loader.load_rows(path, width, start, end, use_native=False)
+        np.testing.assert_array_equal(got, want)
+        # every block except the last is full
+        for b in blocks[:-1]:
+            assert b.shape[0] == block_lines
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    @pytest.mark.parametrize("block_lines", [1, 2, 3, 100])
+    def test_matches_load_rows(self, corpus_file, block_lines, use_native):
+        if use_native:
+            pytest.importorskip("locust_tpu.io.native_ingest")
+        self._assert_stream_matches(
+            corpus_file, 32, block_lines, use_native=use_native
+        )
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    @pytest.mark.parametrize("start,end", [(1, 3), (3, 100), (99, 200), (0, 0)])
+    def test_slices(self, corpus_file, start, end, use_native):
+        if use_native:
+            pytest.importorskip("locust_tpu.io.native_ingest")
+        self._assert_stream_matches(
+            corpus_file, 32, 2, start, end, use_native=use_native
+        )
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_chunk_boundaries_and_long_lines(self, tmp_path, use_native):
+        if use_native:
+            pytest.importorskip("locust_tpu.io.native_ingest")
+        # Lines crossing every chunk boundary + one line far beyond the
+        # python reader's 64KB test chunk (and width), + empty lines.
+        p = tmp_path / "stress.txt"
+        lines = [b"x" * n for n in (0, 1, 31, 32, 33, 200_000, 0, 5)]
+        p.write_bytes(b"\n".join(lines) + b"\n")
+        self._assert_stream_matches(str(p), 32, 3, use_native=use_native)
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_engine_run_stream_matches_run(self, corpus_file, use_native):
+        if use_native:
+            pytest.importorskip("locust_tpu.io.native_ingest")
+        from locust_tpu.config import EngineConfig
+        from locust_tpu.engine import MapReduceEngine
+
+        cfg = EngineConfig(block_lines=2, line_width=32)
+        eng = MapReduceEngine(cfg)
+        res_full = eng.run(loader.load_rows(corpus_file, 32, use_native=False))
+        res_stream = eng.run_stream(
+            loader.StreamingCorpus(corpus_file, 32, 2, use_native=use_native)
+        )
+        assert res_stream.to_host_pairs() == res_full.to_host_pairs()
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "empty.txt"
+        p.write_bytes(b"")
+        assert list(loader.StreamingCorpus(str(p), 32, 4, use_native=False)) == []
+
+    def test_fingerprint_changes_with_content(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_bytes(b"hello\n")
+        f1 = loader.StreamingCorpus(str(p), 32, 4).fingerprint()
+        import os, time
+        time.sleep(0.01)
+        p.write_bytes(b"world\n")
+        f2 = loader.StreamingCorpus(str(p), 32, 4).fingerprint()
+        assert f1 != f2
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_cr_semantics_canonical(tmp_path, use_native):
+    """Split on \\n ONLY; strip exactly one trailing \\r; a lone \\r is
+    data; a \\r at a truncated position is data (not CRLF)."""
+    if use_native:
+        pytest.importorskip("locust_tpu.io.native_ingest")
+    p = tmp_path / "cr.txt"
+    w = 8
+    long_line = b"x" * (w - 1) + b"\r" + b"yyy"     # \r at width-1 is DATA
+    p.write_bytes(
+        b"a\rb\n"          # lone \r inside a line: data
+        b"crlf\r\n"        # CRLF: strip one
+        b"two\r\r\n"       # \r\r\n: strip ONE, keep the first \r
+        + long_line + b"\n"
+    )
+    want = [b"a\rb", b"crlf", b"two\r", long_line[:w]]
+    rows = loader.load_rows(str(p), w, use_native=use_native)
+    assert bytes_ops.rows_to_strings(rows) == [ln[:w] for ln in want]
+    blocks = list(
+        loader.StreamingCorpus(str(p), w, 2, use_native=use_native,
+                               chunk_bytes=1 << 16)
+    )
+    got = [r for b in blocks for r in bytes_ops.rows_to_strings(b)]
+    assert got == [ln[:w] for ln in want]
