@@ -189,7 +189,7 @@ class SortResult:
                 "output is truncated — raise skew_factor",
                 self.overflow,
             )
-        if jax.process_count() > 1:  # pragma: no cover - multihost gather
+        if jax.process_count() > 1:  # exercised by tests/test_multiprocess.py
             from jax.experimental import multihost_utils
 
             lanes, values, valid = multihost_utils.process_allgather(

@@ -668,7 +668,7 @@ def _gather_batch_host(table: KVBatch) -> KVBatch:
     """
     import numpy as np
 
-    if jax.process_count() > 1:  # pragma: no cover - needs multihost
+    if jax.process_count() > 1:  # exercised by tests/test_multiprocess.py
         from jax.experimental import multihost_utils
 
         lanes, values, valid = multihost_utils.process_allgather(

@@ -1,14 +1,25 @@
-"""Worker entrypoint for the multi-process distributed test.
+"""Worker entrypoint for the multi-process distributed tests.
 
 Each OS process joins the JAX coordination service, contributes 2 virtual
-CPU devices to a 4-device global mesh, and runs the SAME global WordCount;
-process 0 writes the gathered result table as JSON.  This is the standard
-JAX recipe for exercising the multi-host path (coordinator + per-process
+CPU devices to a 4-device global mesh, and runs the SAME global program;
+process 0 writes the gathered result as JSON.  This is the standard JAX
+recipe for exercising the multi-host path (coordinator + per-process
 ``jax.distributed.initialize`` + ``make_array_from_process_local_data``)
 without a TPU pod — the real-pod launch differs only in addresses
 (SURVEY.md §7.3.5).
 
+Modes (VERDICT r2 missing #8 — r2 features must run under process_count>1):
+
+  wordcount   DistributedMapReduce end-to-end (the original test)
+  checkpoint  crash injected mid-run, then a FRESH engine resumes from the
+              per-process npz snapshots — exercises the multihost
+              ``process_allgather`` snapshot gather and the
+              ``make_array_from_callback`` resume scatter
+  invindex    DistributedInvertedIndex across process boundaries
+  samplesort  DistributedSampleSort + its multihost result gather
+
 Usage: multiprocess_worker.py <coordinator> <num_procs> <pid> <out_json>
+       <mode> [checkpoint_dir]
 Env (set by the spawning test, BEFORE jax import):
   JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2
 """
@@ -16,19 +27,112 @@ Env (set by the spawning test, BEFORE jax import):
 import json
 import sys
 
+BASE_LINES = [
+    b"the quick brown fox jumps over the dog",
+    b"pack my box with five dozen liquor jugs",
+    b"the five boxing wizards jump quickly",
+    b"sphinx of black quartz judge my vow",
+]
+
+
+def run_wordcount(dmr, cfg, out):
+    from locust_tpu.core import bytes_ops
+
+    lines = BASE_LINES * (dmr.lines_per_round // 2)
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = dmr.run(rows)
+    out["pairs"] = [[k.decode(), v] for k, v in res.to_host_pairs()]
+    out["n_lines"] = len(lines)
+
+
+def run_checkpoint(dmr, cfg, out, checkpoint_dir):
+    """Crash at round 2 of 4, rebuild the engine, resume from snapshots."""
+    from locust_tpu.core import bytes_ops
+
+    lines = BASE_LINES * dmr.lines_per_round  # 4 rounds
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    nrounds = -(-rows.shape[0] // dmr.lines_per_round)
+    assert nrounds >= 4, nrounds
+
+    real_step = dmr._step
+    calls = {"n": 0}
+
+    def crashing_step(*args):
+        if calls["n"] == 2:  # deterministic on every process, pre-dispatch
+            raise RuntimeError("injected crash")
+        calls["n"] += 1
+        return real_step(*args)
+
+    dmr._step = crashing_step
+    crashed = False
+    try:
+        dmr.run(rows, checkpoint_dir=checkpoint_dir, checkpoint_every=1,
+                stats_sync_every=1)
+    except RuntimeError as e:
+        crashed = "injected crash" in str(e)
+    assert crashed, "crash injection did not fire"
+
+    # Fresh engine (same config/mesh) resumes from the snapshots.
+    from locust_tpu.config import EngineConfig  # noqa: F401 (same cfg reused)
+    from locust_tpu.parallel import DistributedMapReduce, make_mesh
+
+    dmr2 = DistributedMapReduce(make_mesh(), cfg)
+    resumed_calls = {"n": 0}
+    real2 = dmr2._step
+
+    def counting_step(*args):
+        resumed_calls["n"] += 1
+        return real2(*args)
+
+    dmr2._step = counting_step
+    res = dmr2.run(rows, checkpoint_dir=checkpoint_dir, checkpoint_every=1)
+    out["pairs"] = [[k.decode(), v] for k, v in res.to_host_pairs()]
+    out["n_lines"] = len(lines)
+    out["nrounds"] = nrounds
+    out["resumed_rounds"] = resumed_calls["n"]
+
+
+def run_invindex(mesh, cfg, out):
+    import numpy as np
+
+    from locust_tpu.apps.inverted_index import build_inverted_index_mesh
+
+    lines = BASE_LINES * 8
+    doc_ids = (np.arange(len(lines), dtype=np.int32) // 2).astype(np.int32)
+    index = build_inverted_index_mesh(lines, doc_ids, mesh, cfg)
+    out["index"] = {k.decode(): v for k, v in index.items()}
+    out["doc_ids"] = doc_ids.tolist()
+    out["lines"] = [ln.decode() for ln in lines]
+
+
+def run_samplesort(mesh, cfg, out):
+    import numpy as np
+
+    from locust_tpu.apps.sample_sort import DistributedSort
+    from locust_tpu.core import bytes_ops
+
+    rng = np.random.default_rng(7)
+    words = [b"w%04d" % n for n in rng.integers(0, 500, size=64)]
+    keys = bytes_ops.strings_to_rows(words, cfg.key_width)
+    srt = DistributedSort(mesh, cfg, rows_per_device=64)
+    res = srt.sort_rows(keys)
+    out["sorted"] = [[k.decode(), int(v)] for k, v in res.to_host_sorted()]
+    out["input"] = [w.decode() for w in words]
+
 
 def main() -> int:
-    coordinator, num_procs, pid, out_path = (
+    coordinator, num_procs, pid, out_path, mode = (
         sys.argv[1],
         int(sys.argv[2]),
         int(sys.argv[3]),
         sys.argv[4],
+        sys.argv[5] if len(sys.argv) > 5 else "wordcount",
     )
+    checkpoint_dir = sys.argv[6] if len(sys.argv) > 6 else None
 
     import jax
 
     from locust_tpu.config import EngineConfig
-    from locust_tpu.core import bytes_ops
     from locust_tpu.parallel import DistributedMapReduce, make_mesh
     from locust_tpu.parallel.mesh import initialize_multihost
 
@@ -37,29 +141,22 @@ def main() -> int:
 
     cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
     mesh = make_mesh()  # all devices across all processes
-    dmr = DistributedMapReduce(mesh, cfg)
+    out = {"n_devices": len(jax.devices())}
 
-    # Deterministic corpus, identical on every process.
-    lines = [
-        b"the quick brown fox jumps over the dog",
-        b"pack my box with five dozen liquor jugs",
-        b"the five boxing wizards jump quickly",
-        b"sphinx of black quartz judge my vow",
-    ] * (dmr.lines_per_round // 2)
-    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
-    res = dmr.run(rows)
-    pairs = res.to_host_pairs()
+    if mode == "wordcount":
+        run_wordcount(DistributedMapReduce(mesh, cfg), cfg, out)
+    elif mode == "checkpoint":
+        run_checkpoint(DistributedMapReduce(mesh, cfg), cfg, out, checkpoint_dir)
+    elif mode == "invindex":
+        run_invindex(mesh, cfg, out)
+    elif mode == "samplesort":
+        run_samplesort(mesh, cfg, out)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
 
     if pid == 0:
         with open(out_path, "w") as f:
-            json.dump(
-                {
-                    "pairs": [[k.decode(), v] for k, v in pairs],
-                    "n_devices": len(jax.devices()),
-                    "n_lines": len(lines),
-                },
-                f,
-            )
+            json.dump(out, f)
     return 0
 
 
