@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     p.add_argument("--checkpoint-every", type=positive_int, default=8,
                    help="blocks between snapshots (with --checkpoint-dir)")
+    from locust_tpu.config import SORT_MODES
+
+    p.add_argument("--sort-mode", choices=list(SORT_MODES),
+                   default="hash",
+                   help="Process-stage sort strategy (config.EngineConfig."
+                        "sort_mode); variant timings in artifacts/")
     p.add_argument("--mesh", action="store_true",
                    help="run stage 0/1 on ALL visible devices via the "
                         "all-to-all shuffle engine (DistributedMapReduce) "
@@ -123,6 +129,7 @@ def _run(args) -> int:
         line_width=args.line_width,
         key_width=args.key_width,
         emits_per_line=args.emits_per_line,
+        sort_mode=args.sort_mode,
     )
     eng = MapReduceEngine(cfg)
     inter = args.intermediate or [DEFAULT_INTERMEDIATE]

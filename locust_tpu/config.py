@@ -22,6 +22,11 @@ import dataclasses
 # (hyphens split words, apostrophes split contractions); see SURVEY.md Q11.
 DELIMITERS: bytes = b" ,.-;:'()\"\t"
 
+# The single source of truth for Process-stage sort strategies:
+# EngineConfig validation, the CLI --sort-mode choices, and
+# ops.process_stage.sort_and_compact dispatch all key off this.
+SORT_MODES = ("hash", "hash1", "radix", "lex")
+
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.
 PAD_BYTE: int = 0
@@ -65,9 +70,14 @@ class EngineConfig:
     # 3 sort operands + one index payload + gather, ~2x faster per sort and
     # ~6x faster to compile than full-key sort; equal keys still group
     # adjacently (exact-key segment boundaries downstream), device order is
-    # hash order (host output re-sorts).  "lex": sort full big-endian key
-    # lanes — exact lexicographic device order, the reference's
-    # KIVComparator semantics (KeyValue.h:20-33).
+    # hash order (host output re-sorts).  "hash1": ONE 32-bit sort operand
+    # (31 hash bits + validity bit) — cheaper still; collisions only
+    # duplicate a table row, re-merged downstream (process_stage._folded_key).
+    # "radix": same folded key sorted by O(n) LSD radix passes instead of
+    # the comparison network (ops/radix_sort.py).  "lex": sort full
+    # big-endian key lanes — exact lexicographic device order, the
+    # reference's KIVComparator semantics (KeyValue.h:20-33).
+    # Variant timings: scripts/bench_sort_variants.py -> artifacts/.
     sort_mode: str = "hash"
 
     # Overflow behavior for > emits_per_line tokens: the reference prints
@@ -86,8 +96,10 @@ class EngineConfig:
             raise ValueError("line_width, emits_per_line, block_lines must be positive")
         if self.table_size is not None and self.table_size <= 0:
             raise ValueError("table_size must be positive")
-        if self.sort_mode not in ("hash", "lex"):
-            raise ValueError(f"sort_mode must be 'hash' or 'lex', got {self.sort_mode!r}")
+        if self.sort_mode not in SORT_MODES:
+            raise ValueError(
+                f"sort_mode must be one of {SORT_MODES}, got {self.sort_mode!r}"
+            )
 
     @property
     def key_lanes(self) -> int:

@@ -44,6 +44,10 @@ def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
     """
     if mode == "hash":
         return _hash_sort(batch)
+    if mode == "hash1":
+        return _hash1_sort(batch)
+    if mode == "radix":
+        return _radix_sort(batch)
     if mode == "lex":
         return _lex_sort(batch)
     raise ValueError(f"unknown sort mode {mode!r}")
@@ -74,6 +78,43 @@ def _hash_sort(batch: KVBatch) -> KVBatch:
     h1, h2 = packing.hash_pair(lanes)
     idx = jnp.arange(n, dtype=jnp.int32)
     _, _, _, sidx = jax.lax.sort((invalid, h1, h2, idx), num_keys=3)
+    return KVBatch(
+        key_lanes=lanes[sidx], values=values[sidx], valid=valid[sidx]
+    )
+
+
+def _folded_key(batch: KVBatch) -> jax.Array:
+    """ONE uint32 sort key: 31 hash bits + validity in the top bit.
+
+    Invalid rows get the max key, so ascending order is valid-first —
+    partition and grouping in a single-operand sort.  Collisions between
+    distinct keys (~n^2/2^31 per sort) interleave within a hash run; the
+    downstream segment reduce compares FULL key lanes at boundaries, so
+    the worst case is a duplicated table row which the next fold (same
+    hash -> adjacent again) or the host finalize re-merges — the same
+    safety argument as the 64-bit "hash" mode at half the sort-key
+    bandwidth (scripts/bench_sort_variants.py variants D/E).
+    """
+    h1, _ = packing.hash_pair(batch.key_lanes)
+    return jnp.where(batch.valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+
+
+def _hash1_sort(batch: KVBatch) -> KVBatch:
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    idx = jnp.arange(lanes.shape[0], dtype=jnp.int32)
+    _, sidx = jax.lax.sort((_folded_key(batch), idx), num_keys=1)
+    return KVBatch(
+        key_lanes=lanes[sidx], values=values[sidx], valid=valid[sidx]
+    )
+
+
+def _radix_sort(batch: KVBatch) -> KVBatch:
+    """LSD radix passes over the folded key (ops/radix_sort.py) — the O(n)
+    alternative to lax.sort's comparison network for the Process stage."""
+    from locust_tpu.ops.radix_sort import radix_argsort
+
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    sidx = radix_argsort(_folded_key(batch))
     return KVBatch(
         key_lanes=lanes[sidx], values=values[sidx], valid=valid[sidx]
     )

@@ -73,7 +73,6 @@ def run_checkpoint(dmr, cfg, out, checkpoint_dir):
     assert crashed, "crash injection did not fire"
 
     # Fresh engine (same config/mesh) resumes from the snapshots.
-    from locust_tpu.config import EngineConfig  # noqa: F401 (same cfg reused)
     from locust_tpu.parallel import DistributedMapReduce, make_mesh
 
     dmr2 = DistributedMapReduce(make_mesh(), cfg)

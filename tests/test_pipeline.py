@@ -263,3 +263,49 @@ def test_engine_checkpoint_fingerprint_mismatch_starts_fresh(tmp_path):
     assert dict(res.to_host_pairs()) == dict(
         py_wordcount(SAMPLE * 4, cfg.emits_per_line)
     )
+
+
+@pytest.mark.parametrize("mode", ["hash", "hash1", "radix", "lex"])
+def test_engine_oracle_exact_across_sort_modes(mode):
+    """Every Process-stage sort strategy must produce the identical table
+    (VERDICT r2 missing #2: hash1/radix are the optimized-sort attempts)."""
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.engine import MapReduceEngine
+
+    lines = [
+        b"to be or not to be",
+        b"that is the question",
+        b"to be, to sleep; to dream",
+        b"the the the the",
+    ] * 5
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=12,
+                       sort_mode=mode)
+    got = MapReduceEngine(cfg).run_lines(lines).to_host_pairs()
+    assert got == sorted(py_wordcount(lines, 12).items())
+
+
+@pytest.mark.parametrize("mode", ["hash1", "radix"])
+def test_single_key_sort_modes_group_equal_keys(mode):
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.ops import process_stage
+
+    words = [b"zz", b"aa", b"zz", b"mm", b"aa", b"zz"]
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 8))
+    batch = KVBatch.from_bytes(
+        keys, jnp.arange(6, dtype=jnp.int32), jnp.ones(6, bool)
+    )
+    import jax
+
+    from locust_tpu.core.packing import unpack_keys
+
+    out = process_stage.sort_and_compact(batch, mode=mode)
+    names = bytes_ops.rows_to_strings(
+        np.asarray(jax.device_get(unpack_keys(out.key_lanes)))
+    )
+    # Equal keys must be adjacent (grouping is all the reduce needs).
+    seen = []
+    for n in names:
+        if not seen or seen[-1] != n:
+            seen.append(n)
+    assert len(seen) == 3  # zz, aa, mm in SOME hash order, each contiguous
