@@ -170,15 +170,14 @@ def _run(args) -> int:
             with timer.span("run"):
                 # Each run method syncs internally, so the span is accurate.
                 if args.stream:
+                    kw = {}
                     if args.checkpoint_dir:
-                        print(
-                            "mapreduce: error: --stream does not support "
-                            "--checkpoint-dir on the single-device engine "
-                            "(use --mesh --stream)",
-                            file=sys.stderr,
+                        kw = dict(
+                            checkpoint_dir=args.checkpoint_dir,
+                            every=args.checkpoint_every,
+                            fingerprint=stream.fingerprint(),
                         )
-                        return 2
-                    res = eng.run_stream(stream)
+                    res = eng.run_stream(stream, **kw)
                 elif args.checkpoint_dir:
                     res = eng.run_checkpointed(
                         rows, args.checkpoint_dir, every=args.checkpoint_every

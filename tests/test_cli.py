@@ -125,3 +125,15 @@ def test_cli_mesh_stream_matches_oracle(corpus_file, capsysbinary):
     assert rc == 0
     got = _parse_table(capsysbinary.readouterr().out)
     assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+def test_cli_stream_with_checkpoint(corpus_file, tmp_path, capsysbinary):
+    ckpt = str(tmp_path / "ck")
+    rc = cli.main([corpus_file, "--stream", "--checkpoint-dir", ckpt] + _cfg_args())
+    assert rc == 0
+    first = _parse_table(capsysbinary.readouterr().out)
+    assert first == dict(py_wordcount(CORPUS.splitlines(), 8))
+    # Second run resumes from the final snapshot and must match exactly.
+    rc = cli.main([corpus_file, "--stream", "--checkpoint-dir", ckpt] + _cfg_args())
+    assert rc == 0
+    assert _parse_table(capsysbinary.readouterr().out) == first

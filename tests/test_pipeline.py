@@ -309,3 +309,73 @@ def test_single_key_sort_modes_group_equal_keys(mode):
         if not seen or seen[-1] != n:
             seen.append(n)
     assert len(seen) == 3  # zz, aa, mm in SOME hash order, each contiguous
+
+
+def test_engine_stream_checkpoint_resume(tmp_path):
+    """run_stream + checkpoint: crash mid-stream, resume folds only the
+    remaining blocks and the final table is exact."""
+    from locust_tpu.io.loader import StreamingCorpus
+
+    cfg = small_cfg(block_lines=4)
+    lines = SAMPLE * 6
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"\n".join(lines) + b"\n")
+    sc = lambda: StreamingCorpus(str(p), cfg.line_width, cfg.block_lines)  # noqa: E731
+    eng = MapReduceEngine(cfg)
+    want = dict(eng.run_stream(sc()).to_host_pairs())
+
+    ckpt = str(tmp_path / "ckpt")
+    fp = sc().fingerprint()
+    eng2 = MapReduceEngine(cfg)
+    real_fold = eng2._fold_block
+    calls = {"n": 0}
+
+    def dying_fold(acc, blk):
+        if calls["n"] == 2:
+            raise RuntimeError("simulated crash")
+        calls["n"] += 1
+        return real_fold(acc, blk)
+
+    eng2._fold_block = dying_fold
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng2.run_stream(sc(), checkpoint_dir=ckpt, every=1, fingerprint=fp)
+    eng2._fold_block = real_fold
+    res = eng2.run_stream(sc(), checkpoint_dir=ckpt, every=1, fingerprint=fp)
+    assert dict(res.to_host_pairs()) == want
+    # Resume skipped the completed blocks: a further run folds none at all.
+    eng2._fold_block = dying_fold
+    calls["n"] = 2
+    res3 = eng2.run_stream(sc(), checkpoint_dir=ckpt, every=1, fingerprint=fp)
+    assert dict(res3.to_host_pairs()) == want
+
+
+def test_engine_stream_checkpoint_requires_fingerprint(tmp_path):
+    cfg = small_cfg(block_lines=4)
+    with pytest.raises(ValueError, match="fingerprint"):
+        MapReduceEngine(cfg).run_stream(
+            iter([]), checkpoint_dir=str(tmp_path / "c")
+        )
+
+
+def test_engine_stream_resume_with_exhausted_iterator_keeps_counters(tmp_path):
+    """Regression: resuming with an empty/exhausted iterator must report the
+    RESTORED table and counters, not zeros (code-review r3 finding)."""
+    from locust_tpu.io.loader import StreamingCorpus
+
+    cfg = small_cfg(block_lines=4)
+    lines = SAMPLE * 6
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"\n".join(lines) + b"\n")
+    fp = StreamingCorpus(str(p), cfg.line_width, cfg.block_lines).fingerprint()
+    ckpt = str(tmp_path / "ckpt")
+    eng = MapReduceEngine(cfg)
+    full = eng.run_stream(
+        StreamingCorpus(str(p), cfg.line_width, cfg.block_lines),
+        checkpoint_dir=ckpt, every=1, fingerprint=fp,
+    )
+    res = eng.run_stream(
+        iter([]), checkpoint_dir=ckpt, every=1, fingerprint=fp
+    )
+    assert dict(res.to_host_pairs()) == dict(full.to_host_pairs())
+    assert res.num_segments == full.num_segments
+    assert res.overflow_tokens == full.overflow_tokens
