@@ -98,11 +98,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class ReplayGuard:
-    """Worker-side freshness check: bounded-age timestamps + one-shot nonces."""
+    """Worker-side freshness check: bounded-age timestamps + one-shot nonces.
+
+    Thread-safe: the worker serves connections concurrently, so the nonce
+    set is mutated under a lock.
+    """
 
     def __init__(self, window: float = REPLAY_WINDOW_SECS):
+        import threading
+
         self.window = window
         self._seen: dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def check(self, req: dict) -> None:
         now = time.time()
@@ -112,13 +119,14 @@ class ReplayGuard:
             raise PermissionError("missing freshness stamp — rejecting frame")
         if abs(now - ts) > self.window:
             raise PermissionError("stale frame — rejecting (possible replay)")
-        # Prune expired nonces, then enforce one-shot use.
-        for n, t in list(self._seen.items()):
-            if now - t > self.window:
-                del self._seen[n]
-        if nonce in self._seen:
-            raise PermissionError("nonce reuse — rejecting replayed frame")
-        self._seen[nonce] = now
+        with self._lock:
+            # Prune expired nonces, then enforce one-shot use.
+            for n, t in list(self._seen.items()):
+                if now - t > self.window:
+                    del self._seen[n]
+            if nonce in self._seen:
+                raise PermissionError("nonce reuse — rejecting replayed frame")
+            self._seen[nonce] = now
 
 
 def parse_cluster_file(path: str) -> list[tuple[str, int]]:

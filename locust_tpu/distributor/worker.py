@@ -61,6 +61,7 @@ class Worker:
         map_runner=default_map_runner,
         workdir: str = "/tmp",
         conn_timeout: float = 30.0,
+        max_connections: int = 32,
     ):
         if not secret:
             raise ValueError("worker requires a shared secret (Q8: no open RCE)")
@@ -71,6 +72,13 @@ class Worker:
         self.workdir = os.path.realpath(workdir)
         self.conn_timeout = conn_timeout
         self._replay_guard = protocol.ReplayGuard()
+        self._map_lock = threading.Lock()
+        # Bounded concurrency: without a cap, an unauthenticated peer
+        # opening idle connections would spawn unbounded threads (each
+        # alive up to conn_timeout in recv) — a resource-exhaustion DoS.
+        # When the cap is reached the accept loop stalls, pushing further
+        # peers into the (small) listen backlog instead of into memory.
+        self._conn_slots = threading.BoundedSemaphore(max_connections)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -79,6 +87,14 @@ class Worker:
         self._shutdown = threading.Event()
 
     def serve_forever(self) -> None:
+        """Accept loop: one thread per connection.
+
+        A node's map runs for minutes; with a serial loop that would block
+        the master's pings and chunked fetches (and a reassigned shard's
+        RPC) for the whole duration.  Connections are served concurrently;
+        ``map`` commands still serialize under ``self._map_lock`` — the
+        node has ONE accelerator and concurrent maps would contend for it.
+        """
         while not self._shutdown.is_set():
             try:
                 self._sock.settimeout(0.5)
@@ -87,25 +103,38 @@ class Worker:
                 continue
             except OSError:
                 break
-            with conn:
-                try:
-                    # A silent peer must not hang the daemon: bound the read.
-                    conn.settimeout(self.conn_timeout)
-                    req = protocol.recv_frame(conn, self.secret)
-                    self._replay_guard.check(req)
-                    conn.settimeout(None)  # map subprocesses may run long
-                    resp = self._handle(req)
-                except PermissionError:
-                    continue  # unauthenticated/replayed peer: drop silently
-                except Exception as e:
-                    # A malformed frame must never kill the daemon (that
-                    # would be an unauthenticated remote DoS).
-                    resp = {"status": "error", "error": str(e)}
-                try:
-                    protocol.send_frame(conn, resp, self.secret, sign_fresh=False)
-                except OSError:
-                    pass
+            self._conn_slots.acquire()
+            t = threading.Thread(
+                target=self._serve_one, args=(conn,), daemon=True
+            )
+            t.start()
         self._sock.close()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        finally:
+            self._conn_slots.release()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                # A silent peer must not hang the daemon: bound the read.
+                conn.settimeout(self.conn_timeout)
+                req = protocol.recv_frame(conn, self.secret)
+                self._replay_guard.check(req)
+                conn.settimeout(None)  # map subprocesses may run long
+                resp = self._handle(req)
+            except PermissionError:
+                return  # unauthenticated/replayed peer: drop silently
+            except Exception as e:
+                # A malformed frame must never kill the daemon (that
+                # would be an unauthenticated remote DoS).
+                resp = {"status": "error", "error": str(e)}
+            try:
+                protocol.send_frame(conn, resp, self.secret, sign_fresh=False)
+            except OSError:
+                pass
 
     def serve_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
@@ -123,7 +152,8 @@ class Worker:
             return {"status": "ok", "bye": True}
         if cmd == "map":
             try:
-                return self.map_runner(req)
+                with self._map_lock:  # one accelerator: maps serialize
+                    return self.map_runner(req)
             except Exception as e:  # propagate failure, don't fake-ACK
                 return {"status": "error", "error": repr(e)}
         # fetch: stream back an intermediate file this worker produced, one

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from functools import partial
 from typing import Callable, Sequence
@@ -307,8 +308,6 @@ class MapReduceEngine:
         exactly as in ``run_checkpointed``; a resume re-READS but does not
         re-process already-folded blocks.
         """
-        import os
-
         bl, w = self.cfg.block_lines, self.cfg.line_width
         acc = KVBatch.empty(self._table_size, self.cfg.key_lanes)
         overflow = jnp.int32(0)
@@ -373,8 +372,6 @@ class MapReduceEngine:
         """Restore (start_block, overflow, max_distinct, acc) from a
         matching snapshot; pass-through fresh state otherwise.  Shared by
         ``run_stream`` and ``run_checkpointed``."""
-        import os
-
         start_block = 0
         overflow = jnp.int32(0)
         max_distinct = jnp.int32(0)
@@ -408,8 +405,6 @@ class MapReduceEngine:
         """One atomically-replaced npz: table + cursor + counters can never
         tear apart.  The tmp name keeps the .npz suffix (np.savez appends
         it otherwise)."""
-        import os
-
         tmp = state_path + ".tmp.npz"
         np.savez_compressed(
             tmp,
@@ -442,8 +437,6 @@ class MapReduceEngine:
         re-run with a different corpus/config fingerprint starts fresh.
         Snapshots are a few MB (table_size rows) regardless of corpus size.
         """
-        import os
-
         from locust_tpu.io.serde import fingerprint_corpus
 
         if every < 1:
@@ -486,8 +479,6 @@ class MapReduceEngine:
         )
 
     def _finish(self, acc, num_segments, overflow, times) -> RunResult:
-        import os
-
         if os.environ.get("LOCUST_DEBUG_CHECKS"):
             # Opt-in invariant sweep on the result table (the sanitizer
             # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.

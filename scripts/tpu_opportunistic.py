@@ -66,12 +66,41 @@ def main() -> int:
     if r.returncode != 0:
         print(f"[opp] tpu_checks failed: {r.stderr[-500:]}", file=sys.stderr)
 
-    # Phase 3: engine end-to-end per sort mode at bench shapes.
+    # Phase 2.5: per-stage timing at the REFERENCE's own benchmark shapes
+    # (700 and 4,463 hamlet lines, reference README.md:72-88) — the direct
+    # stage-table comparison against its GTX 1060 numbers.
     sys.path.insert(0, REPO)
     import bench
 
     from locust_tpu.config import EngineConfig
     from locust_tpu.engine import MapReduceEngine
+
+    ham = "/root/reference/hamlet.txt"
+    if os.path.exists(ham):
+        all_lines = open(ham, "rb").read().splitlines()
+        for n_lines in (700, len(all_lines)):
+            eng = MapReduceEngine(EngineConfig(block_lines=1024))
+            rows = eng.rows_from_lines(all_lines[:n_lines])
+            eng.timed_run(rows)  # compile + warm
+            best = None
+            for _ in range(3):
+                r = eng.timed_run(rows)
+                if best is None or r.times.total_ms < best.times.total_ms:
+                    best = r
+            row = {
+                "lines": n_lines,
+                "map_ms": round(best.times.map_ms, 3),
+                "process_ms": round(best.times.process_ms, 3),
+                "reduce_ms": round(best.times.reduce_ms, 3),
+                "total_ms": round(best.times.total_ms, 3),
+                "distinct": best.num_segments,
+                "ref_gpu_ms": {"700": [0.047, 27.646, 1.712],
+                               "4463": [0.040, 78.176, 4.459]}.get(str(n_lines)),
+            }
+            artifacts.record("stage_parity", row)
+            print(f"[opp] stage parity {n_lines} lines: {row}", file=sys.stderr)
+
+    # Phase 3: engine end-to-end per sort mode at bench shapes.
 
     lines = bench.load_corpus(int(os.environ.get("LOCUST_OPP_AB_BYTES", 32 << 20)))
     corpus_bytes = sum(len(ln) + 1 for ln in lines)

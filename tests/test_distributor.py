@@ -304,3 +304,45 @@ def test_chunked_fetch_roundtrips_beyond_frame_limit(tmp_path):
         assert local.read_bytes() == data
     finally:
         _shutdown(w)
+
+
+def test_worker_serves_fetch_during_long_map(tmp_path):
+    """Connections are served concurrently: a slow map must not block a
+    ping or a fetch (the master needs both for retries/chunked transfer)."""
+    import threading as _threading
+    import time as _time
+
+    release = _threading.Event()
+
+    def slow_map(req):
+        release.wait(timeout=30)
+        return {"status": "ok", "returncode": 0, "log": "",
+                "intermediate": req["intermediate"]}
+
+    f = tmp_path / "x.tsv"
+    f.write_bytes(b"word\t1\n")
+    w = Worker(secret=SECRET, map_runner=slow_map, workdir=str(tmp_path))
+    w.serve_in_thread()
+    try:
+        map_resp = {}
+
+        def do_map():
+            map_resp["r"] = master._rpc(
+                w.addr,
+                {"cmd": "map", "file": "f", "intermediate": "i"},
+                SECRET, timeout=60,
+            )
+
+        t = _threading.Thread(target=do_map, daemon=True)
+        t.start()
+        _time.sleep(0.3)  # let the map start and block
+        t0 = _time.monotonic()
+        got = master._rpc(w.addr, {"cmd": "fetch", "path": str(f)}, SECRET,
+                          timeout=10)
+        assert got["status"] == "ok"
+        assert _time.monotonic() - t0 < 5  # did NOT wait for the map
+        release.set()
+        t.join(timeout=30)
+        assert map_resp["r"]["status"] == "ok"
+    finally:
+        _shutdown(w)
