@@ -53,6 +53,8 @@ TIMEOUT_S = float(os.environ.get("LOCUST_BENCH_TIMEOUT", 1200))
 # Wall-clock reserved for the final CPU fallback when the retry loop gives
 # up on the TPU (compile+run of the CPU-sized corpus fits comfortably).
 CPU_RESERVE_S = float(os.environ.get("LOCUST_BENCH_CPU_RESERVE", 420))
+# Smallest budget worth starting a TPU attempt with (probe + compile + runs).
+MIN_TPU_ATTEMPT_S = float(os.environ.get("LOCUST_BENCH_MIN_ATTEMPT", 150))
 
 
 def emit(payload: dict) -> None:
@@ -227,7 +229,7 @@ def orchestrate() -> int:
     attempt = 0
     while True:
         budget = deadline - time.monotonic() - CPU_RESERVE_S
-        if budget < 150:
+        if budget < MIN_TPU_ATTEMPT_S:
             break
         attempt += 1
         env = dict(os.environ)

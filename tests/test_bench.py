@@ -1,0 +1,134 @@
+"""Unit tests for bench.py's orchestrator — the driver-facing retry loop.
+
+The orchestrator is what turns a flapping TPU tunnel into a captured
+BENCH number (VERDICT r2 missing #1); a regression here silently costs a
+round's headline artifact, so its control flow is pinned with stubbed
+child processes (no real TPU, no real subprocesses).
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+class FakeProc:
+    def __init__(self, stdout="", returncode=0):
+        self.stdout = stdout
+        self.returncode = returncode
+
+
+@pytest.fixture
+def capture_emit(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "TIMEOUT_S", 100.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE_S", 30.0)
+    monkeypatch.setattr(bench, "MIN_TPU_ATTEMPT_S", 10.0)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    return capsys
+
+
+def _last_json(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_orchestrator_relays_first_tpu_success(monkeypatch, capture_emit):
+    tpu_row = json.dumps(
+        {"metric": "wordcount_throughput", "value": 30.0, "unit": "MB/s",
+         "vs_baseline": 13.6, "backend": "tpu"}
+    )
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(kw["env"]["LOCUST_BENCH_BACKEND"])
+        return FakeProc(stdout=tpu_row + "\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.orchestrate() == 0
+    row = _last_json(capture_emit)
+    assert row["backend"] == "tpu" and row["value"] == 30.0
+    assert calls == ["tpu"]  # no CPU fallback needed
+
+
+def test_orchestrator_falls_back_to_cpu_after_failures(monkeypatch, capture_emit):
+    cpu_row = json.dumps(
+        {"metric": "wordcount_throughput", "value": 1.0, "unit": "MB/s",
+         "vs_baseline": 0.45, "backend": "cpu"}
+    )
+    calls = []
+
+    # Each stubbed child "takes" 80s; the clock is otherwise frozen, so
+    # with a 200s budget and 45s reserve the loop fits one TPU attempt
+    # and still has reserve left for the CPU fallback.
+    t = {"now": 0.0}
+
+    def fake_run(cmd, **kw):
+        backend = kw["env"]["LOCUST_BENCH_BACKEND"]
+        calls.append(backend)
+        t["now"] += 80.0
+        if backend == "tpu":
+            # Child inherits NO_CPU_RERUN and fails fast with an error row.
+            assert kw["env"]["LOCUST_BENCH_NO_CPU_RERUN"] == "1"
+            return FakeProc(
+                stdout=json.dumps(bench.error_payload("tunnel down")) + "\n",
+                returncode=1,
+            )
+        return FakeProc(stdout=cpu_row + "\n")
+
+    monkeypatch.setattr(bench, "TIMEOUT_S", 200.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE_S", 45.0)
+    monkeypatch.setattr(bench, "MIN_TPU_ATTEMPT_S", 10.0)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "monotonic", lambda: t["now"])
+    assert bench.orchestrate() == 0
+    row = _last_json(capture_emit)
+    assert row["backend"] == "cpu"
+    assert calls[-1] == "cpu" and "tpu" in calls
+
+
+def test_orchestrator_rejects_cpu_row_from_tpu_child(monkeypatch, capture_emit):
+    """A TPU attempt whose child silently landed on CPU must NOT be
+    relayed as the TPU result."""
+    sneaky = json.dumps(
+        {"metric": "wordcount_throughput", "value": 1.0, "unit": "MB/s",
+         "vs_baseline": 0.45, "backend": "cpu"}
+    )
+    calls = []
+    t = {"now": 0.0}
+
+    def fake_run(cmd, **kw):
+        calls.append(kw["env"]["LOCUST_BENCH_BACKEND"])
+        t["now"] += 80.0
+        return FakeProc(stdout=sneaky + "\n")
+
+    # Two 80s mislabeled TPU attempts fit the budget; 40s remains for the
+    # dedicated CPU fallback after the loop gives up.
+    monkeypatch.setattr(bench, "TIMEOUT_S", 200.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE_S", 50.0)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "monotonic", lambda: t["now"])
+    assert bench.orchestrate() == 0
+    # The final relayed row came from the dedicated CPU fallback child,
+    # not from a mislabeled TPU attempt.
+    assert calls[-1] == "cpu"
+
+
+def test_main_routes_inner_and_orchestrator(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "orchestrate", lambda: (seen.setdefault("o", True), 0)[1])
+    monkeypatch.setenv("LOCUST_BENCH_BACKEND", "auto")
+    monkeypatch.delenv("LOCUST_BENCH_INNER", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert seen.get("o") is True
+
+
+def test_error_payload_shape():
+    row = bench.error_payload("boom")
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}
+    assert row["value"] == 0.0
