@@ -114,7 +114,10 @@ def run_bench(backend: str) -> dict:
     target = TARGET_BYTES if backend == "tpu" else CPU_TARGET_BYTES
     lines = load_corpus(target)
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
-    cfg = EngineConfig(block_lines=BLOCK_LINES)
+    cfg = EngineConfig(
+        block_lines=BLOCK_LINES,
+        sort_mode=os.environ.get("LOCUST_BENCH_SORT_MODE", "hash"),
+    )
     eng = MapReduceEngine(cfg)
     rows = eng.rows_from_lines(lines)
     print(
