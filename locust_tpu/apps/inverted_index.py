@@ -22,6 +22,8 @@ TPU-native formulation with static shapes throughout:
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -31,6 +33,8 @@ from locust_tpu.core import bytes_ops, packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import tokenize_block
 from locust_tpu.ops.reduce_stage import segment_reduce
+
+logger = logging.getLogger("locust_tpu")
 
 
 def _sort_pairs(batch: KVBatch) -> KVBatch:
@@ -131,13 +135,25 @@ def build_inverted_index(
     # would host-sync every block and serialize dispatch (round-1 advisor
     # finding); the capacity check only needs the value once, after.
     n_pairs_dev = jnp.int32(0)
+    overflow_dev = jnp.int32(0)
     for b in range(nblocks):
         sl = slice(b * bl, (b + 1) * bl)
-        acc, blk_pairs, _ = _fold_index_jit(
+        acc, blk_pairs, blk_ovf = _fold_index_jit(
             acc, jnp.asarray(rows[sl]), jnp.asarray(ids[sl]), cfg, cap
         )
         n_pairs_dev = jnp.maximum(n_pairs_dev, blk_pairs)
+        overflow_dev = overflow_dev + blk_ovf
     n_pairs = int(n_pairs_dev)
+    if int(overflow_dev):
+        # Missing postings make a silently-wrong index; surface it loudly
+        # (the WordCount per-line drop is reference semantics, but an index
+        # user needs to know postings are absent).
+        logger.warning(
+            "inverted index dropped %d tokens beyond the %d-per-line cap; "
+            "their postings are MISSING — raise emits_per_line",
+            int(overflow_dev),
+            cfg.emits_per_line,
+        )
     if n_pairs > cap:
         raise ValueError(
             f"distinct (word, doc) pairs ({n_pairs}) exceed pairs_capacity "
@@ -204,20 +220,13 @@ class DistributedInvertedIndex:
         # Pairs accumulate across ALL rounds, so the floor is deliberately
         # larger than one round's emits.
         self.pairs_capacity = pairs_capacity or max(4 * cfg.emits_per_block, 4096)
+        self.max_drain_rounds = 2 + -(-cfg.emits_per_block // self.bin_capacity)
+        max_drains = self.max_drain_rounds
         n_lanes = cfg.key_lanes
 
-        def local_step(
-            lines: jax.Array, doc_ids: jax.Array, acc: KVBatch, leftover: KVBatch
-        ):
-            res = tokenize_block(lines, cfg)
-            flat_keys = res.keys.reshape(-1, cfg.key_width)
-            flat_valid = res.valid.reshape(-1)
-            values = jnp.repeat(doc_ids.astype(jnp.int32), cfg.emits_per_line)
-            batch = KVBatch.from_bytes(flat_keys, values, flat_valid)
-            # Local pre-dedup: repeated (word, doc) pairs within the shard
-            # collapse before touching the network (the combiner analog).
-            local, _ = _dedup_sorted_pairs(_sort_pairs(batch))
-
+        def shuffle_round(local: KVBatch, acc: KVBatch, leftover: KVBatch):
+            """One partition + all-to-all + dedup-merge; feed and drain
+            share it (mirror of shuffle.DistributedMapReduce)."""
             send_lanes, send_vals, send_valid, shuf_ovf, new_leftover = (
                 partition_to_bins(
                     KVBatch.concat(local, leftover),
@@ -243,16 +252,61 @@ class DistributedInvertedIndex:
                 values=merged.values[:cap],
                 valid=merged.valid[:cap],
             )
-            backlog = jnp.sum(new_leftover.valid.astype(jnp.int32))
+            # psum'd so every device sees the same value — the while_loop
+            # below then takes the same trip count on all devices.
+            backlog = jax.lax.psum(
+                jnp.sum(new_leftover.valid.astype(jnp.int32)), axis
+            )
+            return new_acc, new_leftover, shuf_ovf, n_pairs, backlog
+
+        def local_step(
+            lines: jax.Array, doc_ids: jax.Array, acc: KVBatch, leftover: KVBatch
+        ):
+            """Feed + ON-DEVICE drain (lax.while_loop): one dispatch per
+            round with no host sync, like DistributedMapReduce.local_step."""
+            res = tokenize_block(lines, cfg)
+            flat_keys = res.keys.reshape(-1, cfg.key_width)
+            flat_valid = res.valid.reshape(-1)
+            values = jnp.repeat(doc_ids.astype(jnp.int32), cfg.emits_per_line)
+            batch = KVBatch.from_bytes(flat_keys, values, flat_valid)
+            # Local pre-dedup: repeated (word, doc) pairs within the shard
+            # collapse before touching the network (the combiner analog).
+            local, _ = _dedup_sorted_pairs(_sort_pairs(batch))
+
+            acc, leftover, shuf_ovf, n_pairs, backlog = shuffle_round(
+                local, acc, leftover
+            )
+            zero_local = KVBatch.empty(local.size, n_lanes)
+
+            def cond(state):
+                _, _, _, _, backlog, drains = state
+                return (backlog > 0) & (drains < max_drains)
+
+            def body(state):
+                acc, leftover, shuf_ovf, _, _, drains = state
+                acc, leftover, so, n_pairs, backlog = shuffle_round(
+                    zero_local, acc, leftover
+                )
+                return (acc, leftover, shuf_ovf + so, n_pairs, backlog,
+                        drains + 1)
+
+            acc, leftover, shuf_ovf, n_pairs, backlog, drains = (
+                jax.lax.while_loop(
+                    cond,
+                    body,
+                    (acc, leftover, shuf_ovf, n_pairs, backlog, jnp.int32(0)),
+                )
+            )
             stats = jnp.stack(
                 [
                     jax.lax.psum(res.overflow, axis),
                     jax.lax.psum(shuf_ovf, axis),
                     jax.lax.pmax(n_pairs, axis),
-                    jax.lax.psum(backlog, axis),
+                    backlog,
+                    drains,
                 ]
             )
-            return new_acc, new_leftover, stats
+            return acc, leftover, stats
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
         self._step = jax.jit(
@@ -261,6 +315,14 @@ class DistributedInvertedIndex:
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), kv_spec, kv_spec),
                 out_specs=(kv_spec, kv_spec, P()),
+            )
+        )
+        # Across-round stats combiner, jitted ONCE per index builder:
+        # overflows/drains ADD, worst-shard pairs MAX, backlog LAST.
+        self._stats_merge = jax.jit(
+            lambda a, b: jnp.stack(
+                [a[0] + b[0], a[1] + b[1], jnp.maximum(a[2], b[2]), b[3],
+                 a[4] + b[4]]
             )
         )
 
@@ -272,7 +334,7 @@ class DistributedInvertedIndex:
         self,
         lines: list[bytes] | np.ndarray,
         doc_ids: np.ndarray,
-        max_drain_rounds: int | None = None,
+        stats_sync_every: int = 16,
     ) -> dict[bytes, list[int]]:
         from jax.sharding import PartitionSpec as P
 
@@ -280,6 +342,10 @@ class DistributedInvertedIndex:
         from locust_tpu.parallel.shuffle import _gather_batch_host
 
         cfg = self.cfg
+        if stats_sync_every < 1:
+            raise ValueError(
+                f"stats_sync_every must be >= 1, got {stats_sync_every}"
+            )
         if not isinstance(lines, np.ndarray):
             rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
         else:
@@ -293,8 +359,6 @@ class DistributedInvertedIndex:
         pad = nrounds * lpr - rows.shape[0]
         rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
         ids = np.concatenate([ids, np.zeros(pad, np.int32)])
-        if max_drain_rounds is None:
-            max_drain_rounds = 2 + -(-cfg.emits_per_block // self.bin_capacity)
 
         sharding = jax.sharding.NamedSharding(self.mesh, P(self.axis))
         acc = jax.device_put(
@@ -304,42 +368,55 @@ class DistributedInvertedIndex:
             KVBatch.empty(self.n_dev * self.leftover_capacity, cfg.key_lanes),
             sharding,
         )
-        zero_feed_cache = []
 
-        def zero_feed():
-            if not zero_feed_cache:
-                zero_feed_cache.append((
-                    shard_rows(
-                        np.zeros((lpr, cfg.line_width), np.uint8),
-                        self.mesh,
-                        self.axis,
-                    ),
-                    shard_rows(np.zeros(lpr, np.int32), self.mesh, self.axis),
-                ))
-            return zero_feed_cache[0]
-
-        from locust_tpu.parallel.shuffle import feed_and_drain
-
+        # Drains run ON DEVICE inside the step; the host only folds stats
+        # in every ``stats_sync_every`` rounds, so round dispatch pipelines
+        # (same RoundStats protocol as DistributedMapReduce.run).
         n_pairs = 0
         shuf_ovf = 0
-        for r in range(nrounds):
-            sl = slice(r * lpr, (r + 1) * lpr)
-            feed = (
-                shard_rows(rows[sl], self.mesh, self.axis),
-                shard_rows(ids[sl], self.mesh, self.axis),
-            )
-            acc, leftover, stats_list, _ = feed_and_drain(
-                self._step, feed, zero_feed, acc, leftover,
-                max_drain_rounds, backlog_idx=3,
-            )
-            for st in stats_list:
-                shuf_ovf += int(st[1])
-                n_pairs = max(n_pairs, int(st[2]))
+        emit_ovf = 0
+
+        def on_sync(st) -> None:
+            nonlocal n_pairs, shuf_ovf, emit_ovf
+            emit_ovf += int(st[0])
+            shuf_ovf += int(st[1])
+            n_pairs = max(n_pairs, int(st[2]))
+            backlog = int(st[3])
+            if backlog > 0:
+                raise RuntimeError(
+                    f"index backlog failed to drain in "
+                    f"{self.max_drain_rounds} rounds ({backlog} pairs "
+                    "remain); raise skew_factor"
+                )
             if shuf_ovf:
                 raise RuntimeError(
                     f"index shuffle lost {shuf_ovf} pairs; "
                     "emits exceeded cfg.emits_per_block"
                 )
+
+        from locust_tpu.parallel.shuffle import RoundStats
+
+        round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
+        for r in range(nrounds):
+            sl = slice(r * lpr, (r + 1) * lpr)
+            acc, leftover, stats = self._step(
+                shard_rows(rows[sl], self.mesh, self.axis),
+                shard_rows(ids[sl], self.mesh, self.axis),
+                acc,
+                leftover,
+            )
+            round_stats.push(stats)
+        round_stats.flush()
+        if emit_ovf:
+            # Missing postings make a silently-wrong index; unlike WordCount
+            # (whose per-line cap is reference semantics, main.cu:141-144),
+            # surface it loudly.
+            logger.warning(
+                "inverted index dropped %d tokens beyond the %d-per-line "
+                "cap; their postings are MISSING — raise emits_per_line",
+                emit_ovf,
+                cfg.emits_per_line,
+            )
         if n_pairs > self.pairs_capacity:
             raise ValueError(
                 f"distinct (word, doc) pairs per shard ({n_pairs}) exceed "

@@ -49,43 +49,42 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def feed_and_drain(
-    step,
-    feed: tuple,
-    zero_feed,
-    acc,
-    leftover,
-    max_drain_rounds: int,
-    backlog_idx: int,
-):
-    """One feed step + drain rounds until the shuffle backlog is empty.
+class RoundStats:
+    """Device-side stats accumulation with periodic host syncs.
 
-    The shared host-side retry protocol (SURVEY §7.3.3 overflow rounds)
-    used by DistributedMapReduce and DistributedInvertedIndex: run ``step``
-    on ``feed``, then repeat with ``zero_feed()`` (lazily built empty
-    input) while ``stats[backlog_idx]`` is nonzero.  Each drain moves at
-    least one entry per backlogged destination, so the loop terminates;
-    ``max_drain_rounds`` turns a violated invariant into an error instead
-    of an infinite loop.
-
-    Returns (acc, leftover, host_stats_per_step, drains_used).
+    The shared half of the drain/sync protocol (used by
+    DistributedMapReduce and apps.DistributedInvertedIndex): per-round
+    replicated stat vectors fold together ON DEVICE via ``merge_fn`` and
+    reach the host only every ``every`` rounds, when ``on_sync(host_row)``
+    folds them into host counters and polices invariants.  Keeping this in
+    one place means a protocol fix (what syncs, when, what raises) cannot
+    silently diverge between the engines.
     """
-    acc, leftover, stats = step(*feed, acc, leftover)
-    st = jax.device_get(stats)
-    stats_list = [st]
-    drains = 0
-    while int(st[backlog_idx]) > 0:
-        if drains >= max_drain_rounds:
-            raise RuntimeError(
-                f"shuffle backlog failed to drain in {max_drain_rounds} "
-                f"rounds ({int(st[backlog_idx])} entries remain); raise "
-                "skew_factor"
-            )
-        acc, leftover, stats = step(*zero_feed(), acc, leftover)
-        st = jax.device_get(stats)
-        stats_list.append(st)
-        drains += 1
-    return acc, leftover, stats_list, drains
+
+    def __init__(self, merge_fn, on_sync, every: int):
+        if every < 1:
+            raise ValueError(f"stats_sync_every must be >= 1, got {every}")
+        # merge_fn should be jitted ONCE by its owner (per engine, not per
+        # run) so repeated runs reuse the compiled combiner.
+        self._merge = merge_fn
+        self._on_sync = on_sync
+        self._every = every
+        self._acc = None
+        self._rounds = 0
+
+    def push(self, stats) -> None:
+        self._acc = stats if self._acc is None else self._merge(self._acc, stats)
+        self._rounds += 1
+        if self._rounds >= self._every:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._acc is None:
+            return
+        st = jax.device_get(self._acc)
+        self._acc = None
+        self._rounds = 0
+        self._on_sync(st)
 
 
 def partition_to_bins(
@@ -331,10 +330,10 @@ class DistributedMapReduce:
                 out_specs=(kv_spec, kv_spec, P()),
             )
         )
-        # Elementwise combiner for ACROSS-ROUND stats accumulation, kept on
-        # device so run() never syncs per round: overflows/drains ADD,
-        # distinct/backlog take the LAST round's value, worst-shard
-        # distinct takes the MAX.
+        # Elementwise combiner for ACROSS-ROUND stats accumulation, jitted
+        # ONCE per engine (not per run) and kept on device so run() never
+        # syncs per round: overflows/drains ADD, distinct/backlog take the
+        # LAST round's value, worst-shard distinct takes the MAX.
         self._stats_merge = jax.jit(
             lambda a, b: jnp.stack(
                 [a[0] + b[0], a[1] + b[1], b[2], b[3],
@@ -548,19 +547,10 @@ class DistributedMapReduce:
 
         # Device-side stats accumulator: rounds dispatch back-to-back and
         # the host folds the replicated stats vector in only at sync points.
-        stats_acc = None
-        rounds_since_sync = 0
-
-        def sync_stats() -> None:
+        def on_sync(st) -> None:
             """Fold accumulated device stats into host counters; police
             the no-loss invariants (loudly, if a few rounds late)."""
-            nonlocal stats_acc, rounds_since_sync
             nonlocal emit_ovf, shuf_ovf, distinct, drains_used, truncated
-            if stats_acc is None:
-                return
-            st = jax.device_get(stats_acc)
-            stats_acc = None
-            rounds_since_sync = 0
             emit_ovf += int(st[0])
             shuf_ovf += int(st[1])
             distinct = int(st[2])
@@ -584,6 +574,7 @@ class DistributedMapReduce:
                     "map_fn emitted more than cfg.emits_per_block live rows"
                 )
 
+        round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
         last_snapshot = start_round
         nrounds = start_round
         for r, chunk in enumerate(chunk_iter):
@@ -603,19 +594,12 @@ class DistributedMapReduce:
                 chunk = padded
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
             acc, leftover, stats = self._step(sharded, acc, leftover)
-            stats_acc = (
-                stats
-                if stats_acc is None
-                else self._stats_merge(stats_acc, stats)
-            )
-            rounds_since_sync += 1
-            if rounds_since_sync >= stats_sync_every:
-                sync_stats()
+            round_stats.push(stats)
             if state_path is not None and (r + 1) % checkpoint_every == 0:
-                sync_stats()  # snapshots must persist correct counters
+                round_stats.flush()  # snapshots must persist correct counters
                 snapshot(r + 1)
                 last_snapshot = r + 1
-        sync_stats()
+        round_stats.flush()
         if state_path is not None and last_snapshot != nrounds:
             snapshot(nrounds)
         if truncated:

@@ -319,3 +319,27 @@ class TestShardedPageRank:
         assert plan["src_l"].shape[0] == spr.n_dev
         assert plan["src_l"].shape[1] < len(src)  # edges/n_dev-ish, padded
         assert plan["cap"] <= spr.npd + 8  # at most one slot per owned node
+
+
+def test_inverted_index_warns_on_dropped_postings(caplog):
+    """Tokens beyond emits_per_line mean MISSING postings; both index
+    builders must warn loudly (code-review r3 finding)."""
+    import logging
+
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.apps.inverted_index import (
+        build_inverted_index,
+        build_inverted_index_mesh,
+    )
+
+    lines = [b"a b c d e f"]  # 6 tokens > cap of 4
+    ids = np.array([0], np.int32)
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=4)
+    with caplog.at_level(logging.WARNING, logger="locust_tpu"):
+        build_inverted_index(lines, ids, cfg)
+    assert any("MISSING" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="locust_tpu"):
+        build_inverted_index_mesh(lines, ids, make_mesh(), cfg)
+    assert any("MISSING" in r.message for r in caplog.records)
