@@ -12,5 +12,13 @@ rebuilds, layer by layer.
 
 __version__ = "0.1.0"
 
-from locust_tpu.config import DEFAULT_CONFIG, DELIMITERS, EngineConfig  # noqa: F401
+# Deliberately light: heavy modules (engine, apps, parallel) import
+# lazily from their own paths so `python -m locust_tpu --help` stays fast.
+from locust_tpu.config import (  # noqa: F401
+    DEFAULT_CONFIG,
+    DELIMITERS,
+    SORT_MODES,
+    EngineConfig,
+)
 from locust_tpu.core.kv import KVBatch  # noqa: F401
+from locust_tpu.io.loader import StreamingCorpus  # noqa: F401

@@ -1,2 +1,11 @@
-from locust_tpu.apps.inverted_index import build_inverted_index  # noqa: F401
-from locust_tpu.apps.pagerank import DistributedPageRank, pagerank  # noqa: F401
+from locust_tpu.apps.inverted_index import (  # noqa: F401
+    DistributedInvertedIndex,
+    build_inverted_index,
+    build_inverted_index_mesh,
+)
+from locust_tpu.apps.pagerank import (  # noqa: F401
+    DistributedPageRank,
+    ShardedPageRank,
+    pagerank,
+)
+from locust_tpu.apps.sample_sort import DistributedSort, sort_strings  # noqa: F401
