@@ -128,6 +128,28 @@ def main() -> int:
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "modes": results},
     )
 
+    # Phase 3.5: block_lines tuning at the headline-bench shape — dispatch
+    # granularity vs per-block sort size is the one free knob left.
+    results = {}
+    for bl in (16384, 32768, 65536):
+        eng = MapReduceEngine(EngineConfig(block_lines=bl))
+        blocks = eng.prepare_blocks(eng.rows_from_lines(lines))
+        blocks.block_until_ready()
+        eng.run_blocks(blocks)  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            res = eng.run_blocks(blocks)
+            best = min(best, res.times.total_ms / 1e3)
+        results[str(bl)] = {
+            "mb_s": round(corpus_bytes / 1e6 / best, 2),
+            "best_s": round(best, 4),
+        }
+        print(f"[opp] block_lines={bl}: {results[str(bl)]}", file=sys.stderr)
+    artifacts.record(
+        "block_lines_ab",
+        {"corpus_mb": round(corpus_bytes / 1e6, 1), "blocks": results},
+    )
+
     # Phase 4 (optional): big streaming corpus in bounded RSS.
     stream_mb = int(os.environ.get("LOCUST_OPP_STREAM_MB", 0))
     if stream_mb:
