@@ -336,16 +336,7 @@ class DistributedInvertedIndex:
         doc_ids: np.ndarray,
         stats_sync_every: int = 16,
     ) -> dict[bytes, list[int]]:
-        from jax.sharding import PartitionSpec as P
-
-        from locust_tpu.parallel.mesh import shard_rows
-        from locust_tpu.parallel.shuffle import _gather_batch_host
-
         cfg = self.cfg
-        if stats_sync_every < 1:
-            raise ValueError(
-                f"stats_sync_every must be >= 1, got {stats_sync_every}"
-            )
         if not isinstance(lines, np.ndarray):
             rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
         else:
@@ -356,9 +347,32 @@ class DistributedInvertedIndex:
 
         lpr = self.lines_per_round
         nrounds = max(1, -(-rows.shape[0] // lpr))
-        pad = nrounds * lpr - rows.shape[0]
-        rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
-        ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+        chunks = (
+            (rows[r * lpr : (r + 1) * lpr], ids[r * lpr : (r + 1) * lpr])
+            for r in range(nrounds)
+        )
+        return self._run_rounds(chunks, stats_sync_every)
+
+    def run_stream(
+        self, blocks, stats_sync_every: int = 16
+    ) -> dict[bytes, list[int]]:
+        """Bounded-memory variant: ``blocks`` yields
+        ``(rows [<=lines_per_round, width], doc_ids [same length])`` chunk
+        pairs — e.g. zip a ``StreamingCorpus(..., block_lines=
+        self.lines_per_round)`` with a doc-id generator.  Only one chunk
+        plus the sharded pair table are ever resident.
+        """
+        return self._run_rounds(iter(blocks), stats_sync_every)
+
+    def _run_rounds(self, chunk_iter, stats_sync_every: int):
+        from jax.sharding import PartitionSpec as P
+
+        from locust_tpu.parallel.mesh import shard_rows
+        from locust_tpu.parallel.shuffle import _gather_batch_host
+
+        cfg = self.cfg
+        lpr = self.lines_per_round
+        width = cfg.line_width
 
         sharding = jax.sharding.NamedSharding(self.mesh, P(self.axis))
         acc = jax.device_put(
@@ -397,11 +411,36 @@ class DistributedInvertedIndex:
         from locust_tpu.parallel.shuffle import RoundStats
 
         round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
-        for r in range(nrounds):
-            sl = slice(r * lpr, (r + 1) * lpr)
+        for rows_chunk, ids_chunk in chunk_iter:
+            rows_chunk = np.asarray(rows_chunk, dtype=np.uint8)
+            if rows_chunk.shape[1] > width:
+                # Silently slicing off columns would drop tokens (missing
+                # postings); a width mismatch is a caller config error.
+                raise ValueError(
+                    f"chunk rows are {rows_chunk.shape[1]} bytes wide but "
+                    f"cfg.line_width={width}; ingest with the same width"
+                )
+            ids_chunk = np.asarray(ids_chunk, dtype=np.int32)
+            if rows_chunk.shape[0] != ids_chunk.shape[0]:
+                raise ValueError(
+                    f"chunk has {rows_chunk.shape[0]} lines but "
+                    f"{ids_chunk.shape[0]} doc ids"
+                )
+            if rows_chunk.shape[0] > lpr:
+                raise ValueError(
+                    f"round chunk has {rows_chunk.shape[0]} rows, more than "
+                    f"lines_per_round={lpr}"
+                )
+            if rows_chunk.shape[0] < lpr or rows_chunk.shape[1] < width:
+                padded = np.zeros((lpr, width), np.uint8)
+                padded[: rows_chunk.shape[0], : rows_chunk.shape[1]] = rows_chunk
+                rows_chunk = padded
+                ids_chunk = np.concatenate(
+                    [ids_chunk, np.zeros(lpr - ids_chunk.shape[0], np.int32)]
+                )
             acc, leftover, stats = self._step(
-                shard_rows(rows[sl], self.mesh, self.axis),
-                shard_rows(ids[sl], self.mesh, self.axis),
+                shard_rows(rows_chunk, self.mesh, self.axis),
+                shard_rows(ids_chunk, self.mesh, self.axis),
                 acc,
                 leftover,
             )

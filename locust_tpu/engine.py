@@ -339,7 +339,15 @@ class MapReduceEngine:
         for i, blk in enumerate(blocks):
             if i < start_block:  # resume: re-read, don't re-fold
                 continue
-            blk = np.asarray(blk, dtype=np.uint8)[:, :w]
+            blk = np.asarray(blk, dtype=np.uint8)
+            if blk.shape[1] > w:
+                # Line-to-width truncation is an INGEST-time semantic
+                # (strings_to_rows/StreamingCorpus); rows wider than the
+                # engine's width are a caller config error, not data.
+                raise ValueError(
+                    f"stream block rows are {blk.shape[1]} bytes wide but "
+                    f"cfg.line_width={w}; ingest with the same width"
+                )
             if blk.shape[0] > bl:
                 raise ValueError(
                     f"stream block has {blk.shape[0]} rows, more than "

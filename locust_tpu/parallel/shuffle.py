@@ -581,7 +581,12 @@ class DistributedMapReduce:
             if r < start_round:  # resume: skip already-folded rounds
                 continue
             nrounds = r + 1
-            chunk = np.asarray(chunk, dtype=np.uint8)[:, :width]
+            chunk = np.asarray(chunk, dtype=np.uint8)
+            if chunk.shape[1] > width:
+                raise ValueError(
+                    f"round block rows are {chunk.shape[1]} bytes wide but "
+                    f"cfg.line_width={width}; ingest with the same width"
+                )
             if chunk.shape[0] > lpr:
                 raise ValueError(
                     f"round block has {chunk.shape[0]} rows, more than "

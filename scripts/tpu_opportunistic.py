@@ -104,10 +104,14 @@ def main() -> int:
 
     lines = bench.load_corpus(int(os.environ.get("LOCUST_OPP_AB_BYTES", 32 << 20)))
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
+    # One host-side conversion feeds every engine in phases 3 and 3.5
+    # (identical line_width): rows_from_lines over a 32MB corpus costs
+    # seconds of tunnel-window time per call.
+    rows_ab = MapReduceEngine(EngineConfig(block_lines=32768)).rows_from_lines(lines)
     results = {}
     for mode in ("hash", "hash1", "radix"):
         eng = MapReduceEngine(EngineConfig(block_lines=32768, sort_mode=mode))
-        blocks = eng.prepare_blocks(eng.rows_from_lines(lines))
+        blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
         t0 = time.perf_counter()
         eng.run_blocks(blocks)  # compile + warm
@@ -133,7 +137,7 @@ def main() -> int:
     results = {}
     for bl in (16384, 32768, 65536):
         eng = MapReduceEngine(EngineConfig(block_lines=bl))
-        blocks = eng.prepare_blocks(eng.rows_from_lines(lines))
+        blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
         eng.run_blocks(blocks)  # compile + warm
         best = float("inf")

@@ -343,3 +343,22 @@ def test_inverted_index_warns_on_dropped_postings(caplog):
     with caplog.at_level(logging.WARNING, logger="locust_tpu"):
         build_inverted_index_mesh(lines, ids, make_mesh(), cfg)
     assert any("MISSING" in r.message for r in caplog.records)
+
+
+def test_distributed_inverted_index_stream_matches_run():
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.apps.inverted_index import DistributedInvertedIndex
+
+    lines = [b"alpha beta", b"beta gamma", b"gamma alpha", b"delta"] * 9
+    ids = (np.arange(len(lines)) // 3).astype(np.int32)
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    dii = DistributedInvertedIndex(make_mesh(8), cfg)
+    want = dii.run(rows, ids)
+    lpr = dii.lines_per_round
+    got = dii.run_stream(
+        (rows[i : i + lpr], ids[i : i + lpr]) for i in range(0, len(lines), lpr)
+    )
+    assert got == want
