@@ -411,30 +411,17 @@ class DistributedInvertedIndex:
         from locust_tpu.parallel.shuffle import RoundStats
 
         round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
+        from locust_tpu.parallel.shuffle import normalize_round_chunk
+
         for rows_chunk, ids_chunk in chunk_iter:
-            rows_chunk = np.asarray(rows_chunk, dtype=np.uint8)
-            if rows_chunk.shape[1] > width:
-                # Silently slicing off columns would drop tokens (missing
-                # postings); a width mismatch is a caller config error.
-                raise ValueError(
-                    f"chunk rows are {rows_chunk.shape[1]} bytes wide but "
-                    f"cfg.line_width={width}; ingest with the same width"
-                )
             ids_chunk = np.asarray(ids_chunk, dtype=np.int32)
-            if rows_chunk.shape[0] != ids_chunk.shape[0]:
+            if np.asarray(rows_chunk).shape[0] != ids_chunk.shape[0]:
                 raise ValueError(
-                    f"chunk has {rows_chunk.shape[0]} lines but "
+                    f"chunk has {np.asarray(rows_chunk).shape[0]} lines but "
                     f"{ids_chunk.shape[0]} doc ids"
                 )
-            if rows_chunk.shape[0] > lpr:
-                raise ValueError(
-                    f"round chunk has {rows_chunk.shape[0]} rows, more than "
-                    f"lines_per_round={lpr}"
-                )
-            if rows_chunk.shape[0] < lpr or rows_chunk.shape[1] < width:
-                padded = np.zeros((lpr, width), np.uint8)
-                padded[: rows_chunk.shape[0], : rows_chunk.shape[1]] = rows_chunk
-                rows_chunk = padded
+            rows_chunk = normalize_round_chunk(rows_chunk, lpr, width)
+            if ids_chunk.shape[0] < lpr:
                 ids_chunk = np.concatenate(
                     [ids_chunk, np.zeros(lpr - ids_chunk.shape[0], np.int32)]
                 )

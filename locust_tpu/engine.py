@@ -44,7 +44,11 @@ from locust_tpu.core import bytes_ops
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.process_stage import sort_and_compact
-from locust_tpu.ops.reduce_stage import segment_reduce, segment_reduce_into
+from locust_tpu.ops.reduce_stage import (
+    normalize_combine,
+    segment_reduce,
+    segment_reduce_into,
+)
 
 logger = logging.getLogger("locust_tpu")
 
@@ -121,8 +125,12 @@ class MapReduceEngine:
         combine: str = "sum",
     ):
         self.cfg = cfg
+        self.combine = combine  # user-facing semantics (host finalize)
+        # "count" lowers to emit-1 + sum so the block-accumulator merge is
+        # associative (reduce_stage.normalize_combine); the device pipeline
+        # below uses the normalized pair throughout.
+        map_fn, combine = normalize_combine(map_fn, combine)
         self.map_fn = map_fn
-        self.combine = combine
         tsize = cfg.resolved_table_size
         mode = cfg.sort_mode
 

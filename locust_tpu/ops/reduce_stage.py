@@ -34,6 +34,38 @@ from locust_tpu.core.kv import KVBatch
 COMBINERS = ("sum", "min", "max", "count")
 
 
+def normalize_combine(map_fn, combine: str):
+    """Lower "count" to an associative form for MULTI-LEVEL engines.
+
+    "count" is not a monoid over its own outputs: merging two per-key
+    counts must SUM them, while a second ``segment_reduce(..., "count")``
+    would count table ROWS — every engine that folds partial tables
+    (block accumulator, cross-round shard carry, cross-slice combine)
+    would return the number of partials holding the key, not the count.
+    The associative equivalent is exact: emit value 1 at the leaves and
+    sum at every level.  Returns ``(map_fn', combine')``; identity for
+    the genuinely associative combiners.  Single-level uses (one
+    ``segment_reduce`` over raw emits, e.g. the inverted index's postings
+    counts) keep calling "count" directly.
+    """
+    if combine != "count":
+        return map_fn, combine
+
+    def count_map(lines, cfg, _base=map_fn):
+        kv, overflow = _base(lines, cfg)
+        return (
+            KVBatch(
+                key_lanes=kv.key_lanes,
+                values=jnp.ones_like(kv.values),
+                valid=kv.valid,
+            ),
+            overflow,
+        )
+
+    count_map.__name__ = f"count_of_{getattr(map_fn, '__name__', 'map_fn')}"
+    return count_map, "sum"
+
+
 def segment_reduce_into(
     batch: KVBatch, out_size: int, combine: str = "sum"
 ) -> tuple[KVBatch, jax.Array]:

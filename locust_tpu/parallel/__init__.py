@@ -1,11 +1,15 @@
 from locust_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
+    SLICE_AXIS,
     initialize_multihost,
     make_mesh,
+    make_mesh_2d,
     shard_rows,
 )
 from locust_tpu.parallel.shuffle import (  # noqa: F401
     DistributedMapReduce,
     DistributedResult,
+    RoundStats,
     partition_to_bins,
 )
+from locust_tpu.parallel.hierarchical import HierarchicalMapReduce  # noqa: F401

@@ -23,6 +23,7 @@ import numpy as np
 
 
 DATA_AXIS = "data"
+SLICE_AXIS = "slice"
 
 
 def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> jax.sharding.Mesh:
@@ -32,6 +33,34 @@ def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> jax.s
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
     return jax.sharding.Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def make_mesh_2d(
+    n_slices: int,
+    devs_per_slice: int | None = None,
+    slice_axis: str = SLICE_AXIS,
+    data_axis: str = DATA_AXIS,
+) -> jax.sharding.Mesh:
+    """2-D ``[slice, data]`` mesh for the hierarchical engine.
+
+    The ``data`` (minor) axis should map to devices connected by ICI (a
+    TPU slice); the ``slice`` (major) axis to groups connected by DCN
+    (multi-slice / multi-pod).  ``jax.devices()`` enumerates devices
+    process-major, which on real pods is exactly slice-major order, so a
+    plain reshape gives the right locality.
+    """
+    devs = jax.devices()
+    if devs_per_slice is None:
+        if len(devs) % n_slices:
+            raise ValueError(
+                f"{len(devs)} devices do not divide into {n_slices} slices"
+            )
+        devs_per_slice = len(devs) // n_slices
+    need = n_slices * devs_per_slice
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_slices, devs_per_slice)
+    return jax.sharding.Mesh(grid, (slice_axis, data_axis))
 
 
 def initialize_multihost(

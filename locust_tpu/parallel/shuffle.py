@@ -49,6 +49,36 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def normalize_round_chunk(chunk, lpr: int, width: int):
+    """Validate + zero-pad one round's host chunk to ``[lpr, width]``.
+
+    The single copy of the chunk contract shared by every round loop
+    (flat/hierarchical engines, inverted index): wider-than-config rows
+    are a caller error (silently slicing them would drop tokens), more
+    rows than a round holds likewise; short/narrow chunks zero-pad.
+    """
+    import numpy as np
+
+    chunk = np.asarray(chunk, dtype=np.uint8)
+    if chunk.ndim != 2:
+        raise ValueError(f"round chunk must be 2-D, got shape {chunk.shape}")
+    if chunk.shape[1] > width:
+        raise ValueError(
+            f"round chunk rows are {chunk.shape[1]} bytes wide but "
+            f"cfg.line_width={width}; ingest with the same width"
+        )
+    if chunk.shape[0] > lpr:
+        raise ValueError(
+            f"round chunk has {chunk.shape[0]} rows, more than "
+            f"lines_per_round={lpr}; size stream blocks to lines_per_round"
+        )
+    if chunk.shape[0] < lpr or chunk.shape[1] < width:
+        padded = np.zeros((lpr, width), np.uint8)
+        padded[: chunk.shape[0], : chunk.shape[1]] = chunk
+        chunk = padded
+    return chunk
+
+
 class RoundStats:
     """Device-side stats accumulation with periodic host syncs.
 
@@ -61,14 +91,19 @@ class RoundStats:
     silently diverge between the engines.
     """
 
-    def __init__(self, merge_fn, on_sync, every: int):
+    def __init__(self, merge_fn, on_sync, every: int, fetch_fn=None):
         if every < 1:
             raise ValueError(f"stats_sync_every must be >= 1, got {every}")
         # merge_fn should be jitted ONCE by its owner (per engine, not per
-        # run) so repeated runs reuse the compiled combiner.
+        # run) so repeated runs reuse the compiled combiner.  fetch_fn
+        # overrides the device->host pull for stats that are NOT fully
+        # replicated (the hierarchical engine's slice-varying stack spans
+        # non-addressable devices on multi-process pods; its fetch runs a
+        # replicating gather first).
         self._merge = merge_fn
         self._on_sync = on_sync
         self._every = every
+        self._fetch = fetch_fn or jax.device_get
         self._acc = None
         self._rounds = 0
 
@@ -81,7 +116,7 @@ class RoundStats:
     def flush(self) -> None:
         if self._acc is None:
             return
-        st = jax.device_get(self._acc)
+        st = self._fetch(self._acc)
         self._acc = None
         self._rounds = 0
         self._on_sync(st)
@@ -166,6 +201,154 @@ def partition_to_bins(
     return out_lanes, out_vals, out_valid, overflow, leftover
 
 
+def build_shuffle_step(
+    cfg: EngineConfig,
+    map_fn,
+    combine: str,
+    n_bins: int,
+    bin_capacity: int,
+    shard_capacity: int,
+    leftover_capacity: int,
+    max_drains: int,
+    shuffle_axis: str,
+    stat_axes,
+):
+    """The per-device feed+drain body shared by the flat and hierarchical
+    engines (one copy, so the drain/stats protocol cannot diverge).
+
+    ``shuffle_axis`` carries the all-to-all; ``stat_axes`` is the axis
+    tuple the stats/backlog reduce over — for the flat engine it is the
+    (only) shuffle axis, for the hierarchical engine it is the intra-slice
+    axis ONLY, so nothing in the round path ever crosses slices: the
+    backlog psum stays intra-slice (each slice takes its own drain trip
+    count — valid SPMD, since every collective inside the loop body is
+    intra-slice too) and the stats vector leaves the step varying over the
+    slice axis for the host to fold at sync points.
+
+    Stats vector layout (shared): [emit_ovf_sum, shuf_ovf_sum,
+    distinct_sum, backlog, distinct_max, drains], each reduced over
+    ``stat_axes``.
+
+    The caller is responsible for passing a NORMALIZED (map_fn, combine)
+    pair (reduce_stage.normalize_combine): the shard carry and merge here
+    re-apply ``combine`` across levels, which is only correct for
+    associative combiners.
+    """
+    n_lanes = cfg.key_lanes
+
+    def shuffle_round(table_in: KVBatch, acc: KVBatch, leftover: KVBatch):
+        """One partition + all-to-all + merge; shared by feed and drain.
+
+        The carried backlog joins at the PARTITION (whose internal
+        grouping sort is single-key — cheap), not the full local sort:
+        a key present both in the backlog and in new emits is sent
+        twice and merges at its destination's segment reduce.
+        """
+        send_lanes, send_vals, send_valid, shuf_ovf, new_leftover = (
+            partition_to_bins(
+                KVBatch.concat(table_in, leftover),
+                n_bins,
+                bin_capacity,
+                leftover_capacity=leftover_capacity,
+            )
+        )
+        # The ICI shuffle: one all-to-all per tensor.
+        recv_lanes = jax.lax.all_to_all(send_lanes, shuffle_axis, 0, 0)
+        recv_vals = jax.lax.all_to_all(send_vals, shuffle_axis, 0, 0)
+        recv_valid = jax.lax.all_to_all(send_valid, shuffle_axis, 0, 0)
+
+        received = KVBatch(
+            key_lanes=recv_lanes.reshape(-1, n_lanes),
+            values=recv_vals.reshape(-1),
+            valid=recv_valid.reshape(-1),
+        )
+        # Merge what we received with our carried shard, re-reduce.
+        both = KVBatch.concat(acc, received)
+        new_acc, distinct = segment_reduce_into(
+            sort_and_compact(both, cfg.sort_mode),
+            shard_capacity,
+            combine,
+        )
+        # The backlog rides psum over stat_axes so every device in the
+        # shuffle group sees the same value — which is what lets the drain
+        # loop run ON DEVICE: the group takes one lax.while_loop trip
+        # count and its collectives stay in lockstep.
+        backlog = jax.lax.psum(
+            jnp.sum(new_leftover.valid.astype(jnp.int32)), stat_axes
+        )
+        return new_acc, new_leftover, shuf_ovf, distinct, backlog
+
+    def local_step(lines: jax.Array, acc: KVBatch, leftover: KVBatch):
+        """Per-device body (runs under shard_map): feed + on-device drain.
+
+        VERDICT r2 weak #3: the drain loop used to live on the HOST,
+        costing one blocking device_get per feed round even when the
+        backlog was empty — serializing dispatch on high-latency
+        remote-TPU links.  Folding it into lax.while_loop makes the
+        whole feed-plus-drain one device dispatch; the host only syncs
+        stats every ``stats_sync_every`` rounds.
+        """
+        kv, emit_ovf = map_fn(lines, cfg)
+        local_table = segment_reduce(sort_and_compact(kv, cfg.sort_mode), combine)
+        acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(
+            local_table, acc, leftover
+        )
+        zero_table = KVBatch.empty(local_table.size, n_lanes)
+
+        def cond(state):
+            _, _, _, _, backlog, drains = state
+            return (backlog > 0) & (drains < max_drains)
+
+        def body(state):
+            acc, leftover, shuf_ovf, _, _, drains = state
+            acc, leftover, so, distinct, backlog = shuffle_round(
+                zero_table, acc, leftover
+            )
+            return (acc, leftover, shuf_ovf + so, distinct, backlog, drains + 1)
+
+        acc, leftover, shuf_ovf, distinct, backlog, drains = jax.lax.while_loop(
+            cond,
+            body,
+            (acc, leftover, shuf_ovf, distinct, backlog, jnp.int32(0)),
+        )
+        # Truncation is a PER-SHARD event: distinct keys arriving at one
+        # device beyond its table capacity are dropped there (mirror of
+        # RunResult.truncated, engine._finish).  pmax surfaces the worst
+        # shard's pre-slice distinct count.  psum/pmax over stat_axes make
+        # the vector identical within the shuffle group; the caller's
+        # out_spec decides whether that is fully replicated (flat) or
+        # slice-varying (hierarchical).  backlog is already reduced;
+        # nonzero after max_drains means the emits_per_block invariant was
+        # violated (host raises at the next stats sync).
+        stats = jnp.stack(
+            [
+                jax.lax.psum(emit_ovf, stat_axes),
+                jax.lax.psum(shuf_ovf, stat_axes),
+                jax.lax.psum(distinct, stat_axes),
+                backlog,
+                jax.lax.pmax(distinct, stat_axes),
+                drains,
+            ]
+        )
+        return acc, leftover, stats
+
+    return local_step
+
+
+# Across-round elementwise merge for the shared stats layout: overflows and
+# drains ADD, distinct/backlog take the LAST round's value, worst-shard
+# distinct takes the MAX.  Operates on [..., 6]-shaped stacks so the
+# hierarchical engine's per-slice rows fold with the same code.
+def merge_stats_vectors(a, b):
+    a = a.reshape(-1, 6)
+    b = b.reshape(-1, 6)
+    return jnp.stack(
+        [a[:, 0] + b[:, 0], a[:, 1] + b[:, 1], b[:, 2], b[:, 3],
+         jnp.maximum(a[:, 4], b[:, 4]), a[:, 5] + b[:, 5]],
+        axis=1,
+    ).reshape(-1)
+
+
 class DistributedMapReduce:
     """Mesh-parallel MapReduce: shard_map(local pipeline + all-to-all).
 
@@ -217,111 +400,39 @@ class DistributedMapReduce:
         # bounds one round's distinct keys, and run() drains the backlog to
         # zero between rounds, so this never overflows (see run()).
         self.leftover_capacity = cfg.emits_per_block if on_overflow == "retry" else 0
-        n_lanes = cfg.key_lanes
         axis = axis_name
 
         self.max_drain_rounds = 2 + -(-cfg.emits_per_block // self.bin_capacity)
-        max_drains = self.max_drain_rounds
 
-        def shuffle_round(table_in: KVBatch, acc: KVBatch, leftover: KVBatch):
-            """One partition + all-to-all + merge; shared by feed and drain.
+        # "count" lowers to emit-1 + sum so the shard carry and merge are
+        # associative across rounds (reduce_stage.normalize_combine);
+        # self.combine stays the user semantic for the host finalize.
+        from locust_tpu.ops.reduce_stage import normalize_combine
 
-            The carried backlog joins at the PARTITION (whose internal
-            grouping sort is single-key — cheap), not the full local sort:
-            a key present both in the backlog and in new emits is sent
-            twice and merges at its destination's segment reduce.
-            """
-            send_lanes, send_vals, send_valid, shuf_ovf, new_leftover = (
-                partition_to_bins(
-                    KVBatch.concat(table_in, leftover),
-                    self.n_dev,
-                    self.bin_capacity,
-                    leftover_capacity=self.leftover_capacity,
-                )
-            )
-            # The ICI shuffle: one all-to-all per tensor.
-            recv_lanes = jax.lax.all_to_all(send_lanes, axis, 0, 0)
-            recv_vals = jax.lax.all_to_all(send_vals, axis, 0, 0)
-            recv_valid = jax.lax.all_to_all(send_valid, axis, 0, 0)
-
-            received = KVBatch(
-                key_lanes=recv_lanes.reshape(-1, n_lanes),
-                values=recv_vals.reshape(-1),
-                valid=recv_valid.reshape(-1),
-            )
-            # Merge what we received with our carried shard, re-reduce.
-            both = KVBatch.concat(acc, received)
-            new_acc, distinct = segment_reduce_into(
-                sort_and_compact(both, cfg.sort_mode),
-                self.shard_capacity,
-                combine,
-            )
-            # Global backlog rides psum so every device sees the same value
-            # — which is exactly what lets the drain loop run ON DEVICE:
-            # all devices take the same lax.while_loop trip count, so the
-            # collectives inside the body stay in lockstep.
-            backlog = jax.lax.psum(
-                jnp.sum(new_leftover.valid.astype(jnp.int32)), axis
-            )
-            return new_acc, new_leftover, shuf_ovf, distinct, backlog
-
-        def local_step(lines: jax.Array, acc: KVBatch, leftover: KVBatch):
-            """Per-device body (runs under shard_map): feed + on-device drain.
-
-            VERDICT r2 weak #3: the drain loop used to live on the HOST,
-            costing one blocking device_get per feed round even when the
-            backlog was empty — serializing dispatch on high-latency
-            remote-TPU links.  Folding it into lax.while_loop makes the
-            whole feed-plus-drain one device dispatch; the host only syncs
-            stats every ``stats_sync_every`` rounds (run()).
-            """
-            kv, emit_ovf = map_fn(lines, cfg)
-            local_table = segment_reduce(sort_and_compact(kv, cfg.sort_mode), combine)
-            acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(
-                local_table, acc, leftover
-            )
-            zero_table = KVBatch.empty(local_table.size, n_lanes)
-
-            def cond(state):
-                _, _, _, _, backlog, drains = state
-                return (backlog > 0) & (drains < max_drains)
-
-            def body(state):
-                acc, leftover, shuf_ovf, _, _, drains = state
-                acc, leftover, so, distinct, backlog = shuffle_round(
-                    zero_table, acc, leftover
-                )
-                return (acc, leftover, shuf_ovf + so, distinct, backlog, drains + 1)
-
-            acc, leftover, shuf_ovf, distinct, backlog, drains = jax.lax.while_loop(
-                cond,
-                body,
-                (acc, leftover, shuf_ovf, distinct, backlog, jnp.int32(0)),
-            )
-            # Truncation is a PER-SHARD event: distinct keys arriving at one
-            # device beyond its table capacity are dropped there (mirror of
-            # RunResult.truncated, engine._finish).  pmax surfaces the worst
-            # shard's pre-slice distinct count.
-            # Global scalar stats ride psum — the "final combine" collective.
-            # psum/pmax output is identical on every device, so the stats
-            # leave shard_map REPLICATED (out_spec P()): every process can
-            # read them without touching non-addressable shards.  backlog is
-            # already psum'd; nonzero after max_drains means the
-            # emits_per_block invariant was violated (host raises at the
-            # next stats sync).
-            stats = jnp.stack(
-                [
-                    jax.lax.psum(emit_ovf, axis),
-                    jax.lax.psum(shuf_ovf, axis),
-                    jax.lax.psum(distinct, axis),
-                    backlog,
-                    jax.lax.pmax(distinct, axis),
-                    drains,
-                ]
-            )
-            return acc, leftover, stats
+        norm_map_fn, norm_combine = normalize_combine(map_fn, combine)
+        # Checkpoints fingerprint the NORMALIZED map identity: a "count"
+        # table written by the pre-normalization merge (different, broken
+        # semantics) must not resume under the fixed one.
+        self._norm_map_name = getattr(
+            norm_map_fn, "__name__", str(norm_map_fn)
+        )
+        local_step = build_shuffle_step(
+            cfg,
+            norm_map_fn,
+            norm_combine,
+            n_bins=self.n_dev,
+            bin_capacity=self.bin_capacity,
+            shard_capacity=self.shard_capacity,
+            leftover_capacity=self.leftover_capacity,
+            max_drains=self.max_drain_rounds,
+            shuffle_axis=axis,
+            stat_axes=(axis,),
+        )
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
+        # Stats are reduced over the mesh's only axis, so they leave
+        # shard_map REPLICATED (out_spec P()): every process can read them
+        # without touching non-addressable shards.
         self._step = jax.jit(
             jax.shard_map(
                 local_step,
@@ -330,16 +441,9 @@ class DistributedMapReduce:
                 out_specs=(kv_spec, kv_spec, P()),
             )
         )
-        # Elementwise combiner for ACROSS-ROUND stats accumulation, jitted
-        # ONCE per engine (not per run) and kept on device so run() never
-        # syncs per round: overflows/drains ADD, distinct/backlog take the
-        # LAST round's value, worst-shard distinct takes the MAX.
-        self._stats_merge = jax.jit(
-            lambda a, b: jnp.stack(
-                [a[0] + b[0], a[1] + b[1], b[2], b[3],
-                 jnp.maximum(a[4], b[4]), a[5] + b[5]]
-            )
-        )
+        # Across-round stats accumulation, jitted ONCE per engine (not per
+        # run) and kept on device so run() never syncs per round.
+        self._stats_merge = jax.jit(merge_stats_vectors)
 
     # ------------------------------------------------------------------ api
 
@@ -367,7 +471,8 @@ class DistributedMapReduce:
             combine=self.combine,
             # Without the map_fn identity, a resume after changing map_fn
             # would silently reuse the stale table (ADVICE r2, medium).
-            map_fn=getattr(self.map_fn, "__name__", str(self.map_fn)),
+            # The NORMALIZED name also invalidates pre-fix "count" tables.
+            map_fn=self._norm_map_name,
             mesh=f"{self.n_dev}x{self.axis}",
             bin_capacity=self.bin_capacity,
             shard_capacity=self.shard_capacity,
@@ -581,22 +686,7 @@ class DistributedMapReduce:
             if r < start_round:  # resume: skip already-folded rounds
                 continue
             nrounds = r + 1
-            chunk = np.asarray(chunk, dtype=np.uint8)
-            if chunk.shape[1] > width:
-                raise ValueError(
-                    f"round block rows are {chunk.shape[1]} bytes wide but "
-                    f"cfg.line_width={width}; ingest with the same width"
-                )
-            if chunk.shape[0] > lpr:
-                raise ValueError(
-                    f"round block has {chunk.shape[0]} rows, more than "
-                    f"lines_per_round={lpr}; size stream blocks to "
-                    "DistributedMapReduce.lines_per_round"
-                )
-            if chunk.shape[0] < lpr or chunk.shape[1] < width:
-                padded = np.zeros((lpr, width), np.uint8)
-                padded[: chunk.shape[0], : chunk.shape[1]] = chunk
-                chunk = padded
+            chunk = normalize_round_chunk(chunk, lpr, width)
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
             acc, leftover, stats = self._step(sharded, acc, leftover)
             round_stats.push(stats)

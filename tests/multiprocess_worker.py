@@ -104,6 +104,24 @@ def run_invindex(mesh, cfg, out):
     out["lines"] = [ln.decode() for ln in lines]
 
 
+def run_hierarchical(cfg, out):
+    """2 slices x 2 devices, slice axis ACROSS processes: exercises the
+    slice-varying stats fetch (a plain device_get would touch
+    non-addressable devices) and the cross-slice combine over DCN."""
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+    from locust_tpu.parallel.mesh import make_mesh_2d
+
+    mesh2 = make_mesh_2d(2, 2)
+    h = HierarchicalMapReduce(mesh2, cfg)
+    lines = BASE_LINES * (2 * h.lines_per_round // len(BASE_LINES))
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = h.run(rows, stats_sync_every=1)  # sync every round: worst case
+    out["pairs"] = [[k.decode(), v] for k, v in res.to_host_pairs()]
+    out["n_lines"] = len(lines)
+    out["distinct"] = res.distinct
+
+
 def run_samplesort(mesh, cfg, out):
     import numpy as np
 
@@ -150,6 +168,8 @@ def main() -> int:
         run_invindex(mesh, cfg, out)
     elif mode == "samplesort":
         run_samplesort(mesh, cfg, out)
+    elif mode == "hierarchical":
+        run_hierarchical(cfg, out)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
 

@@ -155,3 +155,16 @@ def test_two_process_sample_sort(tmp_path):
     assert got == sorted(result["input"])
     # Payloads are a permutation of the original indices.
     assert sorted(v for _, v in result["sorted"]) == list(range(len(got)))
+
+
+@pytest.mark.slow
+def test_two_process_hierarchical(tmp_path):
+    """[2 slices x 2 devices] with the SLICE axis across process
+    boundaries: per-round collectives stay intra-process (ICI analog),
+    the slice-varying stats fetch must replicate before device_get, and
+    the one cross-slice combine crosses processes (DCN analog)."""
+    result = _run_workers(tmp_path, "hierarchical")
+    got = {k.encode(): v for k, v in result["pairs"]}
+    oracle = _wordcount_oracle(result["n_lines"])
+    assert got == dict(oracle)
+    assert result["distinct"] == len(oracle)
