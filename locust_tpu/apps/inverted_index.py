@@ -415,9 +415,10 @@ class DistributedInvertedIndex:
 
         for rows_chunk, ids_chunk in chunk_iter:
             ids_chunk = np.asarray(ids_chunk, dtype=np.int32)
-            if np.asarray(rows_chunk).shape[0] != ids_chunk.shape[0]:
+            rows_chunk = np.asarray(rows_chunk, dtype=np.uint8)
+            if rows_chunk.shape[0] != ids_chunk.shape[0]:
                 raise ValueError(
-                    f"chunk has {np.asarray(rows_chunk).shape[0]} lines but "
+                    f"chunk has {rows_chunk.shape[0]} lines but "
                     f"{ids_chunk.shape[0]} doc ids"
                 )
             rows_chunk = normalize_round_chunk(rows_chunk, lpr, width)

@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "all-to-all shuffle engine (DistributedMapReduce) "
                         "instead of the single-device engine; prints "
                         "per-shard stats on stderr")
+    p.add_argument("--slices", type=int, default=None,
+                   help="with --mesh: use the hierarchical engine on a "
+                        "[slices, devices/slice] mesh — per-round shuffle "
+                        "stays intra-slice (ICI), slices combine once at "
+                        "the end (DCN)")
     p.add_argument("--stream", action="store_true",
                    help="bounded-memory ingest: stream the corpus in "
                         "blocks instead of materializing it (for corpora "
@@ -116,6 +121,9 @@ def _run(args) -> int:
         print(f"mapreduce: error: {e}", file=sys.stderr)
         return 1
     print(f"[locust] backend: {backend}", file=sys.stderr)
+
+    if args.slices and not args.mesh:
+        args.mesh = True  # --slices implies the mesh engine; never ignore it
 
     # Import jax lazily so --help works instantly.
     from locust_tpu.config import EngineConfig
@@ -270,19 +278,42 @@ def _run_mesh(args, cfg, timer, prof) -> int:
     from locust_tpu.parallel.shuffle import DistributedMapReduce
 
     inter = args.intermediate or [DEFAULT_INTERMEDIATE]
-    mesh = make_mesh()
-    dmr = DistributedMapReduce(mesh, cfg)
+    if args.slices:
+        from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+        from locust_tpu.parallel.mesh import make_mesh_2d
+
+        mesh = make_mesh_2d(args.slices)
+        dmr = HierarchicalMapReduce(mesh, cfg)
+        print(
+            f"[locust] hierarchical mesh: {dmr.n_slices} slice(s) x "
+            f"{dmr.devs_per_slice} device(s), {dmr.lines_per_round} "
+            f"lines/round, bin_capacity={dmr.bin_capacity}, "
+            f"shard_capacity={dmr.shard_capacity}",
+            file=sys.stderr,
+        )
+    else:
+        mesh = make_mesh()
+        dmr = DistributedMapReduce(mesh, cfg)
+        print(
+            f"[locust] mesh: {dmr.n_dev} device(s), {dmr.lines_per_round} "
+            f"lines/round, bin_capacity={dmr.bin_capacity}, "
+            f"shard_capacity={dmr.shard_capacity}",
+            file=sys.stderr,
+        )
     n_dev = dmr.n_dev
-    print(
-        f"[locust] mesh: {n_dev} device(s), {dmr.lines_per_round} lines/round, "
-        f"bin_capacity={dmr.bin_capacity}, shard_capacity={dmr.shard_capacity}",
-        file=sys.stderr,
-    )
     with prof:
         t0 = _time.perf_counter()
         with timer.span("load"):
             kw = {}
             if args.checkpoint_dir:
+                if args.slices:
+                    print(
+                        "mapreduce: error: --slices does not support "
+                        "--checkpoint-dir yet (use the flat --mesh engine "
+                        "for resumable runs)",
+                        file=sys.stderr,
+                    )
+                    return 2
                 kw = dict(
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every,
@@ -308,11 +339,12 @@ def _run_mesh(args, cfg, timer, prof) -> int:
             pairs = res.to_host_pairs()  # gathers + syncs
         run_ms = (_time.perf_counter() - t0) * 1e3
 
-        # Per-shard report: each device owns a hash shard of the table.
+        # Per-shard report: one hash shard per shard_capacity rows (the
+        # hierarchical table has devs_per_slice shards, the flat one n_dev).
         shard_live = np.asarray(
             jax.device_get(res.table.valid)
-        ).reshape(n_dev, -1).sum(axis=1)
-        for d in range(n_dev):
+        ).reshape(-1, dmr.shard_capacity).sum(axis=1)
+        for d in range(shard_live.shape[0]):
             print(
                 f"[locust] shard {d}: {int(shard_live[d])} keys",
                 file=sys.stderr,

@@ -347,26 +347,9 @@ class MapReduceEngine:
         for i, blk in enumerate(blocks):
             if i < start_block:  # resume: re-read, don't re-fold
                 continue
-            blk = np.asarray(blk, dtype=np.uint8)
-            if blk.shape[1] > w:
-                # Line-to-width truncation is an INGEST-time semantic
-                # (strings_to_rows/StreamingCorpus); rows wider than the
-                # engine's width are a caller config error, not data.
-                raise ValueError(
-                    f"stream block rows are {blk.shape[1]} bytes wide but "
-                    f"cfg.line_width={w}; ingest with the same width"
-                )
-            if blk.shape[0] > bl:
-                raise ValueError(
-                    f"stream block has {blk.shape[0]} rows, more than "
-                    f"cfg.block_lines={bl}; size stream blocks to the "
-                    "engine's block_lines (each oversize shape would "
-                    "recompile the fold)"
-                )
-            if blk.shape[0] < bl or blk.shape[1] < w:
-                padded = np.zeros((bl, w), np.uint8)
-                padded[: blk.shape[0], : blk.shape[1]] = blk
-                blk = padded
+            from locust_tpu.parallel.shuffle import normalize_round_chunk
+
+            blk = normalize_round_chunk(blk, bl, w)
             acc, blk_overflow, distinct = self._fold_block(acc, jnp.asarray(blk))
             overflow = overflow + blk_overflow
             max_distinct = jnp.maximum(max_distinct, distinct)

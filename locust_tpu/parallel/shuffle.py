@@ -69,8 +69,9 @@ def normalize_round_chunk(chunk, lpr: int, width: int):
         )
     if chunk.shape[0] > lpr:
         raise ValueError(
-            f"round chunk has {chunk.shape[0]} rows, more than "
-            f"lines_per_round={lpr}; size stream blocks to lines_per_round"
+            f"round chunk has {chunk.shape[0]} rows, more than its round "
+            f"capacity of {lpr} (engine block_lines / mesh lines_per_round);"
+            " size stream blocks to match"
         )
     if chunk.shape[0] < lpr or chunk.shape[1] < width:
         padded = np.zeros((lpr, width), np.uint8)

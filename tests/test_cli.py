@@ -137,3 +137,29 @@ def test_cli_stream_with_checkpoint(corpus_file, tmp_path, capsysbinary):
     rc = cli.main([corpus_file, "--stream", "--checkpoint-dir", ckpt] + _cfg_args())
     assert rc == 0
     assert _parse_table(capsysbinary.readouterr().out) == first
+
+
+def test_cli_mesh_slices_matches_oracle(corpus_file, capsysbinary):
+    """--mesh --slices 2 routes through the hierarchical engine."""
+    rc = cli.main([corpus_file, "--mesh", "--slices", "2"] + _cfg_args())
+    assert rc == 0
+    got = _parse_table(capsysbinary.readouterr().out)
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+def test_cli_mesh_slices_stream(corpus_file, capsysbinary):
+    rc = cli.main([corpus_file, "--mesh", "--slices", "2", "--stream"] + _cfg_args())
+    assert rc == 0
+    got = _parse_table(capsysbinary.readouterr().out)
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+def test_cli_slices_implies_mesh(corpus_file, capfd):
+    """--slices without --mesh must not silently fall back to the
+    single-device engine (code-review r3 finding)."""
+    rc = cli.main([corpus_file, "--slices", "2"] + _cfg_args())
+    assert rc == 0
+    captured = capfd.readouterr()
+    assert "hierarchical mesh: 2 slice(s)" in captured.err
+    got = _parse_table(captured.out.encode())
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
