@@ -77,7 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "all-to-all shuffle engine (DistributedMapReduce) "
                         "instead of the single-device engine; prints "
                         "per-shard stats on stderr")
-    p.add_argument("--slices", type=int, default=None,
+    p.add_argument("--slices", type=positive_int, default=None,
                    help="with --mesh: use the hierarchical engine on a "
                         "[slices, devices/slice] mesh — per-round shuffle "
                         "stays intra-slice (ICI), slices combine once at "

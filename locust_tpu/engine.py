@@ -316,6 +316,7 @@ class MapReduceEngine:
         exactly as in ``run_checkpointed``; a resume re-READS but does not
         re-process already-folded blocks.
         """
+        from locust_tpu.parallel.shuffle import normalize_round_chunk
         bl, w = self.cfg.block_lines, self.cfg.line_width
         acc = KVBatch.empty(self._table_size, self.cfg.key_lanes)
         overflow = jnp.int32(0)
@@ -347,8 +348,6 @@ class MapReduceEngine:
         for i, blk in enumerate(blocks):
             if i < start_block:  # resume: re-read, don't re-fold
                 continue
-            from locust_tpu.parallel.shuffle import normalize_round_chunk
-
             blk = normalize_round_chunk(blk, bl, w)
             acc, blk_overflow, distinct = self._fold_block(acc, jnp.asarray(blk))
             overflow = overflow + blk_overflow

@@ -65,6 +65,14 @@ def _load() -> ctypes.CDLL:
                 ctypes.c_long,
                 ctypes.c_long,
             ]
+            lib.ingest_read_tsv.restype = ctypes.c_long
+            lib.ingest_read_tsv.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.c_long,
+                ctypes.c_long,
+            ]
             _lib = lib
     return _lib
 
@@ -99,6 +107,44 @@ def load_rows(
     if wrote < 0:
         raise OSError(f"native ingest failed to read {path!r}")
     return out[:wrote] if wrote < n_rows else out
+
+
+def read_tsv(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Native "key\\tvalue" TSV parse -> (padded key rows, int32 values).
+
+    Two passes over the file (count, then fill) with a fixed 1MB buffer —
+    semantics identical to io/serde.read_tsv's Python path (parity-tested).
+    """
+    lib = _load()
+
+    def check(rc: int) -> int:
+        if rc == -2:
+            # Same exception class as the Python path's int32 check.
+            raise OverflowError(f"TSV value in {path!r} does not fit int32")
+        if rc < 0:
+            raise OSError(f"native TSV read failed for {path!r}")
+        return rc
+
+    null_keys = ctypes.POINTER(ctypes.c_ubyte)()
+    null_vals = ctypes.POINTER(ctypes.c_int)()
+    n = check(
+        lib.ingest_read_tsv(str(path).encode(), null_keys, null_vals, 0, key_width)
+    )
+    keys = np.zeros((n, key_width), dtype=np.uint8)
+    values = np.zeros((n,), dtype=np.int32)
+    if n:
+        wrote = check(
+            lib.ingest_read_tsv(
+                str(path).encode(),
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                values.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+                n,
+                key_width,
+            )
+        )
+        if wrote < n:  # file shrank between passes
+            keys, values = keys[:wrote], values[:wrote]
+    return keys, values
 
 
 def iter_blocks(

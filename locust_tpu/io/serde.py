@@ -32,12 +32,34 @@ def write_tsv(pairs: list[tuple[bytes, int]], path: str) -> None:
             f.write(k + b"\t" + str(int(v)).encode() + b"\n")
 
 
-def read_tsv(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
+def read_tsv(
+    path: str, key_width: int, use_native: bool = True
+) -> tuple[np.ndarray, np.ndarray]:
     """Parse ``key\\tvalue`` TSV -> (padded key rows, int32 values).
 
     Split on the FIRST tab like the reference's parser (main.cu:84-97);
     tolerate reference-style trailing spaces in keys (Q5) and blank lines.
+    A native streaming parser (native/ingest.cpp ``ingest_read_tsv``)
+    handles multi-GB intermediates; this Python loop is the always-
+    available fallback and the semantic reference.
     """
+    if use_native and key_width <= 256:
+        try:
+            from locust_tpu.io import native_ingest
+
+            return native_ingest.read_tsv(path, key_width)
+        except (ImportError, OSError):
+            pass
+    import re
+
+    # The strict value grammar (shared with the native parser): optional
+    # ' '/'\t'/'\r' padding, sign, digits — nothing else.  int(b"1_2") or
+    # form-feed padding would be accepted by bare int() but are malformed
+    # TSV rows; both parsers must agree row-for-row or key/value alignment
+    # would depend on which path ran.  Values beyond int32 raise (a wrap
+    # would silently corrupt counts); fields > 63 bytes are malformed.
+    val_re = re.compile(rb"[ \t\r]*([+-]?[0-9]+)[ \t\r]*\Z")
+
     keys: list[bytes] = []
     values: list[int] = []
     with open(path, "rb") as f:
@@ -49,10 +71,15 @@ def read_tsv(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
             key = key.rstrip(b" ")  # reference writes "key \t..." (Q5)
             if not key:
                 continue
-            try:
-                values.append(int(val))
-            except ValueError:
+            m = val_re.fullmatch(val) if len(val) <= 63 else None
+            if m is None:
                 continue  # malformed row: skip, like the reference's atoi-0 rows
+            v = int(m.group(1))
+            if not (-(2**31) <= v < 2**31):
+                raise OverflowError(
+                    f"TSV value {v} in {path!r} does not fit int32"
+                )
+            values.append(v)
             keys.append(key)
     return bytes_ops.strings_to_rows(keys, key_width), np.asarray(
         values, dtype=np.int32

@@ -185,4 +185,136 @@ long ingest_load_window(const char* path, long* inout_offset,
   return row;
 }
 
+// Streaming "key\tvalue" TSV parser — the native fast path for the
+// reduce stage's intermediate loads (python analog: io/serde.read_tsv;
+// reference analog: loadIntermediateFile, main.cu:66-103).  Semantics
+// must match serde.read_tsv EXACTLY (parity-tested):
+//   * split each line at the FIRST tab,
+//   * strip trailing ' ' from the key (the reference writes "key \t", Q5)
+//     — at the key's true end only, not at the width-truncation point,
+//   * keys NUL-pad / truncate to key_width,
+//   * values parse as base-10 ints with surrounding whitespace tolerated
+//     (python int()); malformed values and empty keys skip the row,
+//   * blank lines skip; '\r' before '\n' is stripped.
+// Bounded memory: one fixed 1MB read buffer; per-line state carries only
+// the first key_width key bytes and a small value buffer.
+// Call with out_keys == NULL to COUNT parseable rows (pass 1), then with
+// buffers sized [count, key_width] / [count] to fill (pass 2).
+// Returns rows parsed/filled, or -1 on I/O error.
+long ingest_read_tsv(const char* path, unsigned char* out_keys,
+                     int* out_values, long max_rows, long key_width) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  const long B = 1 << 20;
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(B));
+  if (!buf) {
+    std::fclose(f);
+    return -1;
+  }
+  const bool counting = out_keys == nullptr;
+  long rows = 0;
+  bool range_error = false;  // a value outside int32: hard error (-2)
+
+  // Per-line state.  VMAX bounds a VALUE field; longer fields are
+  // malformed rows in BOTH parsers (the strict grammar below).
+  const int VMAX = 63;
+  unsigned char keybuf[256];  // key prefix (key_width <= 256 enforced)
+  unsigned char valbuf[VMAX];
+  long klen = 0;        // total key bytes seen
+  long last_ns = -1;    // index of last non-space key byte
+  int vlen = 0;
+  bool in_value = false;
+  bool val_too_long = false;
+  if (key_width > 256) {
+    std::free(buf);
+    std::fclose(f);
+    return -1;
+  }
+
+  auto isws = [](unsigned char c) {
+    return c == ' ' || c == '\t' || c == '\r';
+  };
+
+  auto finish_line = [&]() {
+    long eff = last_ns + 1;  // key length after trailing-space strip
+    bool ok = eff > 0 && in_value && !val_too_long;
+    long long value = 0;
+    if (ok) {
+      // The STRICT value grammar both parsers implement:
+      //   [ws]* [+-]? [0-9]+ [ws]*      (ws = ' ' '\t' '\r')
+      // Anything else (letters, NULs, underscores, second tabs) skips
+      // the row; a syntactically valid value outside int32 is a HARD
+      // error for the whole file (silent wrap would corrupt counts).
+      int j = 0;
+      while (j < vlen && isws(valbuf[j])) ++j;
+      long long sign = 1;
+      if (j < vlen && (valbuf[j] == '+' || valbuf[j] == '-')) {
+        sign = valbuf[j] == '-' ? -1 : 1;
+        ++j;
+      }
+      const int digits_start = j;
+      while (j < vlen && valbuf[j] >= '0' && valbuf[j] <= '9') {
+        if (value < (1LL << 40))  // keep accumulating until clearly over
+          value = value * 10 + (valbuf[j] - '0');
+        ++j;
+      }
+      if (j == digits_start) ok = false;  // no digits
+      while (j < vlen && isws(valbuf[j])) ++j;
+      if (j != vlen) ok = false;  // trailing junk (incl. NUL bytes)
+      value *= sign;
+      if (ok && (value > 2147483647LL || value < -2147483648LL))
+        range_error = true;
+    }
+    if (ok && !range_error) {
+      if (!counting && rows < max_rows) {
+        long keep = eff < key_width ? eff : key_width;
+        std::memset(out_keys + rows * key_width, 0,
+                    static_cast<size_t>(key_width));
+        std::memcpy(out_keys + rows * key_width, keybuf,
+                    static_cast<size_t>(keep));
+        out_values[rows] = static_cast<int>(value);
+        ++rows;
+      } else if (counting) {
+        ++rows;
+      }
+    }
+    klen = 0;
+    last_ns = -1;
+    vlen = 0;
+    in_value = false;
+    val_too_long = false;
+  };
+
+  for (;;) {
+    long got = static_cast<long>(std::fread(buf, 1, B, f));
+    if (got <= 0) break;
+    for (long i = 0; i < got && !range_error; ++i) {
+      const unsigned char c = buf[i];
+      if (c == '\n') {
+        finish_line();
+      } else if (!in_value) {
+        if (c == '\t') {
+          in_value = true;
+        } else {
+          if (c != ' ') last_ns = klen;  // only ' ' strips from key tails (Q5)
+          if (klen < key_width) keybuf[klen] = c;
+          ++klen;
+        }
+      } else {
+        if (vlen < VMAX) valbuf[vlen++] = c;
+        else val_too_long = true;
+      }
+    }
+    if (range_error) break;
+  }
+  const bool io_error = std::ferror(f) != 0;
+  if (!range_error && !io_error && (klen > 0 || in_value))
+    finish_line();  // trailing line without '\n'
+  std::free(buf);
+  std::fclose(f);
+  if (io_error) return -1;       // mid-file read error, NOT a short file
+  if (range_error) return -2;    // int32 overflow in a value
+  return rows;
+}
+
 }  // extern "C"

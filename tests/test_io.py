@@ -210,3 +210,69 @@ def test_cr_semantics_canonical(tmp_path, use_native):
     )
     got = [r for b in blocks for r in bytes_ops.rows_to_strings(b)]
     assert got == [ln[:w] for ln in want]
+
+
+# ------------------------------------------------------------- native TSV
+
+class TestNativeTsvParity:
+    """ingest_read_tsv must match serde.read_tsv's Python path exactly."""
+
+    CASES = [
+        # (file content, description)
+        (b"word\t3\nother\t-7\n", "clean"),
+        (b"key \t5\n", "reference trailing-space key (Q5)"),
+        (b"a b \t5\nab c\t6\n", "interior spaces kept, trailing stripped"),
+        (b"\nword\t1\n\n", "blank lines skipped"),
+        (b"noval\nword\t2\n", "line without tab skipped"),
+        (b"word\tnotint\nok\t9\n", "malformed value skipped"),
+        (b"word\t 12 \n", "whitespace-padded value accepted"),
+        (b"word\t5", "trailing line without newline (Q1)"),
+        (b"crlf\t4\r\n", "CRLF value"),
+        (b"verylongkey_beyond_width\t8\n", "key truncated to width"),
+        (b"  \t5\n", "all-space key skipped"),
+        (b"tab\t5\t6\n", "second tab makes value malformed: skipped"),
+        (b"", "empty file"),
+        (b"u\t1_2\nok\t3\n", "underscore value malformed (strict grammar)"),
+        (b"v\t5\x0b\nok\t3\n", "vertical-tab padding malformed"),
+        (b"n\t5\x006\nok\t3\n", "NUL byte in value malformed"),
+        (b"L\t" + b" " * 70 + b"5\nok\t3\n", "value field >63 bytes malformed"),
+        (b"z\t+7\nneg\t-0\n", "signs accepted"),
+        (b"lead\t0005\n", "leading zeros accepted"),
+    ]
+
+    @pytest.mark.parametrize("content,desc", CASES, ids=[c[1] for c in CASES])
+    def test_parity(self, tmp_path, content, desc):
+        pytest.importorskip("locust_tpu.io.native_ingest")
+        from locust_tpu.io import native_ingest
+
+        p = tmp_path / "t.tsv"
+        p.write_bytes(content)
+        for width in (8, 32):
+            pk, pv = serde.read_tsv(str(p), width, use_native=False)
+            nk, nv = native_ingest.read_tsv(str(p), width)
+            np.testing.assert_array_equal(nk, pk, err_msg=desc)
+            np.testing.assert_array_equal(nv, pv, err_msg=desc)
+
+    def test_int32_overflow_raises_in_both(self, tmp_path):
+        pytest.importorskip("locust_tpu.io.native_ingest")
+        from locust_tpu.io import native_ingest
+
+        p = tmp_path / "o.tsv"
+        p.write_bytes(b"word\t3000000000\n")
+        with pytest.raises(OverflowError):
+            serde.read_tsv(str(p), 16, use_native=False)
+        with pytest.raises(OverflowError):
+            native_ingest.read_tsv(str(p), 16)
+
+    def test_parity_on_real_wordcount_output(self, tmp_path):
+        pytest.importorskip("locust_tpu.io.native_ingest")
+        from locust_tpu.io import native_ingest
+
+        pairs = [(b"w%05d" % i, i * 7 - 3) for i in range(5000)]
+        p = tmp_path / "big.tsv"
+        serde.write_tsv(pairs, str(p))
+        pk, pv = serde.read_tsv(str(p), 32, use_native=False)
+        nk, nv = native_ingest.read_tsv(str(p), 32)
+        np.testing.assert_array_equal(nk, pk)
+        np.testing.assert_array_equal(nv, pv)
+        assert len(nv) == 5000
