@@ -369,6 +369,7 @@ class DistributedMapReduce:
         skew_factor: float = 2.0,
         on_overflow: str = "retry",
         shard_capacity: int | None = None,
+        bin_capacity: int | None = None,
     ):
         if on_overflow not in ("retry", "drop"):
             raise ValueError(f"on_overflow must be 'retry' or 'drop', got {on_overflow!r}")
@@ -380,9 +381,23 @@ class DistributedMapReduce:
         self.on_overflow = on_overflow
         self.n_dev = mesh.shape[axis_name]
         # Per-destination bin capacity: fair share of the local table,
-        # padded for skew, TPU-lane aligned.
-        self.bin_capacity = _round_up(
-            max(1, math.ceil(cfg.emits_per_block / self.n_dev * skew_factor)), 8
+        # padded for skew, TPU-lane aligned.  The all-to-all always moves
+        # FULL bins (XLA needs equal splits), so the default — sized for
+        # the worst case of emits_per_block DISTINCT keys per device — is
+        # mostly padding once the local combiner has collapsed a typical
+        # corpus's emits.  Callers that know their per-block vocabulary
+        # can pass a much smaller ``bin_capacity`` to shrink the wire
+        # volume ~proportionally: in "retry" mode underestimates cost
+        # extra drain rounds, never data (docs/DESIGN.md "shuffle sizing").
+        if bin_capacity is not None and bin_capacity < 1:
+            raise ValueError(f"bin_capacity must be >= 1, got {bin_capacity}")
+        self.bin_capacity = (
+            _round_up(int(bin_capacity), 8)
+            if bin_capacity is not None
+            else _round_up(
+                max(1, math.ceil(cfg.emits_per_block / self.n_dev * skew_factor)),
+                8,
+            )
         )
         # Result-table rows per device (its hash shard of the global table).
         # Decoupled from the per-round receive volume (n_dev * bin_capacity,

@@ -223,6 +223,7 @@ long ingest_read_tsv(const char* path, unsigned char* out_keys,
   long klen = 0;        // total key bytes seen
   long last_ns = -1;    // index of last non-space key byte
   int vlen = 0;
+  long pending_cr = 0;  // run of '\r' that may be the CRLF terminator
   bool in_value = false;
   bool val_too_long = false;
   if (key_width > 256) {
@@ -281,6 +282,7 @@ long ingest_read_tsv(const char* path, unsigned char* out_keys,
     klen = 0;
     last_ns = -1;
     vlen = 0;
+    pending_cr = 0;
     in_value = false;
     val_too_long = false;
   };
@@ -301,8 +303,22 @@ long ingest_read_tsv(const char* path, unsigned char* out_keys,
           ++klen;
         }
       } else {
-        if (vlen < VMAX) valbuf[vlen++] = c;
-        else val_too_long = true;
+        // Trailing '\r' runs are the line terminator, not value bytes
+        // (the Python path rstrips them from the LINE before its length
+        // check); only '\r's later followed by a non-'\r' byte are value
+        // content and count toward the field budget.
+        if (c == '\r') {
+          ++pending_cr;
+        } else {
+          while (pending_cr > 0 && vlen < VMAX) {
+            valbuf[vlen++] = '\r';
+            --pending_cr;
+          }
+          if (pending_cr > 0) val_too_long = true;
+          pending_cr = 0;
+          if (vlen < VMAX) valbuf[vlen++] = c;
+          else val_too_long = true;
+        }
       }
     }
     if (range_error) break;
