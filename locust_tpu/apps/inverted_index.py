@@ -204,15 +204,15 @@ class DistributedInvertedIndex:
         from jax.sharding import PartitionSpec as P
 
         from locust_tpu.parallel.mesh import DATA_AXIS
-        from locust_tpu.parallel.shuffle import _round_up, partition_to_bins
+        from locust_tpu.parallel.shuffle import partition_to_bins, sized_bins
 
         axis = axis_name or DATA_AXIS
         self.mesh = mesh
         self.cfg = cfg
         self.axis = axis
         self.n_dev = mesh.shape[axis]
-        self.bin_capacity = _round_up(
-            max(1, -(-int(cfg.emits_per_block * skew_factor) // self.n_dev)), 8
+        self.bin_capacity = sized_bins(
+            cfg.emits_per_block, self.n_dev, skew_factor
         )
         self.leftover_capacity = cfg.emits_per_block
         # Distinct (word, doc) pairs carried per shard; exceeding it raises

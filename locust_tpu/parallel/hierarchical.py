@@ -53,6 +53,7 @@ from locust_tpu.parallel.shuffle import (
     build_shuffle_step,
     merge_stats_vectors,
     normalize_round_chunk,
+    sized_bins,
 )
 
 logger = logging.getLogger("locust_tpu")
@@ -75,6 +76,7 @@ class HierarchicalMapReduce:
         combine: str = "sum",
         skew_factor: float = 2.0,
         shard_capacity: int | None = None,
+        bin_capacity: int | None = None,
     ):
         if slice_axis not in mesh.shape or data_axis not in mesh.shape:
             raise ValueError(
@@ -91,10 +93,15 @@ class HierarchicalMapReduce:
         self.devs_per_slice = int(mesh.shape[data_axis])
         self.n_dev = self.n_slices * self.devs_per_slice
         # Intra-slice bins: fair share of one device's emits across the
-        # slice's devices, padded for skew (same rule as the flat engine).
-        self.bin_capacity = _round_up(
-            max(1, math.ceil(cfg.emits_per_block / self.devs_per_slice * skew_factor)),
-            8,
+        # slice's devices, padded for skew (same rule as the flat engine);
+        # an explicit bin_capacity shrinks the per-round ICI wire volume
+        # (underestimates cost drain rounds, never data — DESIGN.md §3).
+        if bin_capacity is not None and bin_capacity < 1:
+            raise ValueError(f"bin_capacity must be >= 1, got {bin_capacity}")
+        self.bin_capacity = (
+            _round_up(int(bin_capacity), 8)
+            if bin_capacity is not None
+            else sized_bins(cfg.emits_per_block, self.devs_per_slice, skew_factor)
         )
         self.shard_capacity = (
             shard_capacity

@@ -49,6 +49,16 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def sized_bins(total_rows: int, n_bins: int, skew_factor: float) -> int:
+    """Default per-destination bin capacity: a fair share of ``total_rows``
+    across ``n_bins``, padded for skew, TPU-lane aligned.  The ONE copy of
+    the sizing rule used by every shuffle-shaped engine (flat,
+    hierarchical, inverted index)."""
+    return _round_up(
+        max(1, math.ceil(total_rows / n_bins * skew_factor)), 8
+    )
+
+
 def normalize_round_chunk(chunk, lpr: int, width: int):
     """Validate + zero-pad one round's host chunk to ``[lpr, width]``.
 
@@ -394,10 +404,7 @@ class DistributedMapReduce:
         self.bin_capacity = (
             _round_up(int(bin_capacity), 8)
             if bin_capacity is not None
-            else _round_up(
-                max(1, math.ceil(cfg.emits_per_block / self.n_dev * skew_factor)),
-                8,
-            )
+            else sized_bins(cfg.emits_per_block, self.n_dev, skew_factor)
         )
         # Result-table rows per device (its hash shard of the global table).
         # Decoupled from the per-round receive volume (n_dev * bin_capacity,

@@ -240,7 +240,7 @@ class TestNativeTsvParity:
         (b"lead\t0005\n", "leading zeros accepted"),
         (b"edge\t" + b" " * 62 + b"5\r\n", "63-byte value + CRLF kept"),
         (b"crs\t5" + b"\r" * 80 + b"\n", "many terminator CRs stripped"),
-        (b"icr\t \r 5\nok\t1\n", "interior CR is padding (malformed mid)"),
+        (b"icr\t \r 5\nok\t1\n", "interior CR accepted as padding"),
     ]
 
     @pytest.mark.parametrize("content,desc", CASES, ids=[c[1] for c in CASES])
