@@ -60,9 +60,9 @@ class DistributedSort:
         self.axis = axis_name
         self.n_dev = mesh.shape[axis_name]
         self.rows_per_device = rows_per_device
-        self.bin_capacity = _round_up(
-            max(1, math.ceil(rows_per_device / self.n_dev * skew_factor)), 8
-        )
+        from locust_tpu.parallel.shuffle import sized_bins
+
+        self.bin_capacity = sized_bins(rows_per_device, self.n_dev, skew_factor)
         self.shard_capacity = self.n_dev * self.bin_capacity
         n_lanes = cfg.key_lanes
         axis = axis_name
