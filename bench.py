@@ -48,7 +48,17 @@ import numpy as np
 BASELINE_MB_S = 2.2
 TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_BYTES", 32 * 1024 * 1024))
 CPU_TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_CPU_BYTES", 8 * 1024 * 1024))
-BLOCK_LINES = int(os.environ.get("LOCUST_BENCH_BLOCK_LINES", 32768))
+# Per-backend defaults, each overridable by env.  CPU: hash1 @ 16384 beat
+# hash @ 32768 by 16% (sweep committed in artifacts/bench_block_cpu_r3
+# .jsonl: 8k/16k/32k/64k -> 0.87/0.90/0.67/0.37 MB/s); TPU keeps the measured
+# configuration until the opportunistic sweep's on-hardware A/B says
+# otherwise (artifacts/tpu_runs.jsonl).
+_BLOCK_LINES_ENV = os.environ.get("LOCUST_BENCH_BLOCK_LINES")
+_SORT_MODE_ENV = os.environ.get("LOCUST_BENCH_SORT_MODE")
+_PER_BACKEND = {
+    "tpu": {"block_lines": 32768, "sort_mode": "hash"},
+    "cpu": {"block_lines": 16384, "sort_mode": "hash1"},
+}
 TIMEOUT_S = float(os.environ.get("LOCUST_BENCH_TIMEOUT", 1200))
 # Wall-clock reserved for the final CPU fallback when the retry loop gives
 # up on the TPU (compile+run of the CPU-sized corpus fits comfortably).
@@ -143,15 +153,20 @@ def run_bench(backend: str) -> dict:
     target = TARGET_BYTES if backend == "tpu" else CPU_TARGET_BYTES
     lines = load_corpus(target)
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
+    defaults = _PER_BACKEND.get(backend, _PER_BACKEND["cpu"])
+    block_lines = (
+        int(_BLOCK_LINES_ENV) if _BLOCK_LINES_ENV else defaults["block_lines"]
+    )
     cfg = EngineConfig(
-        block_lines=BLOCK_LINES,
-        sort_mode=os.environ.get("LOCUST_BENCH_SORT_MODE", "hash"),
+        block_lines=block_lines,
+        sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
     )
     eng = MapReduceEngine(cfg)
     rows = eng.rows_from_lines(lines)
     print(
         f"[bench] corpus: {corpus_bytes/1e6:.1f} MB, {len(lines)} lines, "
-        f"block_lines={BLOCK_LINES}, backend={jax.default_backend()}",
+        f"block_lines={block_lines}, sort_mode={cfg.sort_mode}, "
+        f"backend={jax.default_backend()}",
         file=sys.stderr,
     )
 
@@ -200,7 +215,8 @@ def run_bench(backend: str) -> dict:
             **payload,
             "corpus_mb": round(corpus_bytes / 1e6, 1),
             "lines": len(lines),
-            "block_lines": BLOCK_LINES,
+            "block_lines": block_lines,
+            "sort_mode": cfg.sort_mode,
             "best_s": round(best, 4),
             "distinct": res.num_segments,
             "truncated": res.truncated,
