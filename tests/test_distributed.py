@@ -326,3 +326,58 @@ def test_bin_capacity_validation():
     cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
     with pytest.raises(ValueError, match="bin_capacity"):
         DistributedMapReduce(make_mesh(8), cfg, bin_capacity=0)
+
+
+class TestRoundStats:
+    """Unit coverage of the shared accumulate/flush protocol."""
+
+    def test_sync_cadence_and_merge(self):
+        import jax.numpy as jnp
+
+        from locust_tpu.parallel.shuffle import RoundStats, merge_stats_vectors
+
+        synced = []
+        rs = RoundStats(merge_stats_vectors, synced.append, every=3)
+        # overflows ADD, distinct/backlog LAST, max MAX, drains ADD
+        for i in range(1, 7):
+            rs.push(jnp.asarray([1, 10, i, 100 + i, i, 2], jnp.int32))
+        assert len(synced) == 2  # flushed at rounds 3 and 6
+        a = np.asarray(synced[0])
+        assert list(a) == [3, 30, 3, 103, 3, 6]
+        b = np.asarray(synced[1])
+        assert list(b) == [3, 30, 6, 106, 6, 6]
+
+    def test_flush_idempotent_and_final(self):
+        import jax.numpy as jnp
+
+        from locust_tpu.parallel.shuffle import RoundStats, merge_stats_vectors
+
+        synced = []
+        rs = RoundStats(merge_stats_vectors, synced.append, every=100)
+        rs.flush()  # nothing accumulated: no-op
+        assert synced == []
+        rs.push(jnp.asarray([1, 0, 5, 0, 5, 0], jnp.int32))
+        rs.flush()
+        rs.flush()  # second flush: no-op
+        assert len(synced) == 1
+
+    def test_custom_fetch_fn(self):
+        import jax.numpy as jnp
+
+        from locust_tpu.parallel.shuffle import RoundStats, merge_stats_vectors
+
+        fetched, synced = [], []
+
+        def fetch(x):
+            fetched.append(True)
+            return np.asarray(x)
+
+        rs = RoundStats(merge_stats_vectors, synced.append, every=1, fetch_fn=fetch)
+        rs.push(jnp.asarray([0, 0, 1, 0, 1, 0], jnp.int32))
+        assert fetched and len(synced) == 1
+
+    def test_rejects_bad_every(self):
+        from locust_tpu.parallel.shuffle import RoundStats, merge_stats_vectors
+
+        with pytest.raises(ValueError, match="stats_sync_every"):
+            RoundStats(merge_stats_vectors, lambda s: None, every=0)
