@@ -15,12 +15,9 @@ partial window still leaves committed evidence.  Phases, cheapest first:
 Exit codes: 0 = all requested phases captured, 3 = tunnel down, 1 = error.
 """
 
-import json
 import os
-import resource
 import subprocess
 import sys
-import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -29,20 +26,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
-    from locust_tpu.backend import probe_tpu, select_backend
+    import opp_resume
 
-    ok, detail = probe_tpu(timeout_s=float(os.environ.get("LOCUST_OPP_PROBE_S", 90)),
-                           retries=1)
-    if not ok:
-        print(f"[opp] tunnel down: {detail}", file=sys.stderr)
+    if not opp_resume.tunnel_gate():
         return 3
-    select_backend("tpu", probe_timeout_s=120, retries=1)
-
-    import jax
-
-    from locust_tpu.utils import artifacts
-
-    print(f"[opp] on {jax.devices()[0].device_kind}; sweeping", file=sys.stderr)
 
     # Phase 1: sort variants at the engine shape (table + block emits).
     env = dict(os.environ)
@@ -66,119 +53,9 @@ def main() -> int:
     if r.returncode != 0:
         print(f"[opp] tpu_checks failed: {r.stderr[-500:]}", file=sys.stderr)
 
-    # Phase 2.5: per-stage timing at the REFERENCE's own benchmark shapes
-    # (700 and 4,463 hamlet lines, reference README.md:72-88) — the direct
-    # stage-table comparison against its GTX 1060 numbers.
-    sys.path.insert(0, REPO)
-    import bench
-
-    from locust_tpu.config import EngineConfig
-    from locust_tpu.engine import MapReduceEngine
-
-    ham = "/root/reference/hamlet.txt"
-    if os.path.exists(ham):
-        all_lines = open(ham, "rb").read().splitlines()
-        for n_lines in (700, len(all_lines)):
-            eng = MapReduceEngine(EngineConfig(block_lines=1024))
-            rows = eng.rows_from_lines(all_lines[:n_lines])
-            eng.timed_run(rows)  # compile + warm
-            best = None
-            for _ in range(3):
-                r = eng.timed_run(rows)
-                if best is None or r.times.total_ms < best.times.total_ms:
-                    best = r
-            row = {
-                "lines": n_lines,
-                "map_ms": round(best.times.map_ms, 3),
-                "process_ms": round(best.times.process_ms, 3),
-                "reduce_ms": round(best.times.reduce_ms, 3),
-                "total_ms": round(best.times.total_ms, 3),
-                "distinct": best.num_segments,
-                "ref_gpu_ms": {"700": [0.047, 27.646, 1.712],
-                               "4463": [0.040, 78.176, 4.459]}.get(str(n_lines)),
-            }
-            artifacts.record("stage_parity", row)
-            print(f"[opp] stage parity {n_lines} lines: {row}", file=sys.stderr)
-
-    # Phase 3: engine end-to-end per sort mode at bench shapes.
-
-    lines = bench.load_corpus(int(os.environ.get("LOCUST_OPP_AB_BYTES", 32 << 20)))
-    corpus_bytes = sum(len(ln) + 1 for ln in lines)
-    # One host-side conversion feeds every engine in phases 3 and 3.5
-    # (identical line_width): rows_from_lines over a 32MB corpus costs
-    # seconds of tunnel-window time per call.
-    rows_ab = MapReduceEngine(EngineConfig(block_lines=32768)).rows_from_lines(lines)
-    results = {}
-    for mode in ("hash", "hash1", "radix"):
-        eng = MapReduceEngine(EngineConfig(block_lines=32768, sort_mode=mode))
-        blocks = eng.prepare_blocks(rows_ab)
-        blocks.block_until_ready()
-        t0 = time.perf_counter()
-        eng.run_blocks(blocks)  # compile + warm
-        compile_s = time.perf_counter() - t0
-        best = float("inf")
-        for _ in range(3):
-            res = eng.run_blocks(blocks)
-            best = min(best, res.times.total_ms / 1e3)
-        results[mode] = {
-            "mb_s": round(corpus_bytes / 1e6 / best, 2),
-            "best_s": round(best, 4),
-            "compile_s": round(compile_s, 1),
-            "distinct": res.num_segments,
-        }
-        print(f"[opp] mode={mode}: {results[mode]}", file=sys.stderr)
-    artifacts.record(
-        "engine_sort_mode_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "modes": results},
-    )
-
-    # Phase 3.5: block_lines tuning at the headline-bench shape — dispatch
-    # granularity vs per-block sort size is the one free knob left.
-    results = {}
-    for bl in (16384, 32768, 65536):
-        eng = MapReduceEngine(EngineConfig(block_lines=bl))
-        blocks = eng.prepare_blocks(rows_ab)
-        blocks.block_until_ready()
-        eng.run_blocks(blocks)  # compile + warm
-        best = float("inf")
-        for _ in range(3):
-            res = eng.run_blocks(blocks)
-            best = min(best, res.times.total_ms / 1e3)
-        results[str(bl)] = {
-            "mb_s": round(corpus_bytes / 1e6 / best, 2),
-            "best_s": round(best, 4),
-        }
-        print(f"[opp] block_lines={bl}: {results[str(bl)]}", file=sys.stderr)
-    artifacts.record(
-        "block_lines_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "blocks": results},
-    )
-
-    # Phase 4 (optional): big streaming corpus in bounded RSS.
-    stream_mb = int(os.environ.get("LOCUST_OPP_STREAM_MB", 0))
-    if stream_mb:
-        from locust_tpu.io.corpus import write_corpus
-        from locust_tpu.io.loader import StreamingCorpus
-
-        path = f"/tmp/opp_stream_{stream_mb}.txt"
-        if not os.path.exists(path):
-            write_corpus(path, stream_mb * 1_000_000, n_vocab=50_000)
-        size = os.path.getsize(path)
-        eng = MapReduceEngine(EngineConfig(block_lines=32768))
-        t0 = time.perf_counter()
-        res = eng.run_stream(StreamingCorpus(path, 128, 32768))
-        wall = time.perf_counter() - t0
-        rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
-        row = {
-            "corpus_mb": round(size / 1e6, 1),
-            "wall_s": round(wall, 1),
-            "mb_s": round(size / 1e6 / wall, 2),
-            "distinct": res.num_segments,
-            "truncated": res.truncated,
-            "peak_rss_mb": round(rss_mb, 0),
-        }
-        artifacts.record("stream_scale", row)
-        print(f"[opp] stream: {json.dumps(row)}", file=sys.stderr)
+    # Phases 2.5 -> 4 are shared with the window-resume entry point
+    # (scripts/opp_resume.py) so the two sweeps can never diverge.
+    opp_resume.run_phases()
 
     print("[opp] sweep complete", file=sys.stderr)
     return 0
