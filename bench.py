@@ -55,6 +55,11 @@ CPU_TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_CPU_BYTES", 8 * 1024 * 1024)
 # otherwise (artifacts/tpu_runs.jsonl).
 _BLOCK_LINES_ENV = os.environ.get("LOCUST_BENCH_BLOCK_LINES")
 _SORT_MODE_ENV = os.environ.get("LOCUST_BENCH_SORT_MODE")
+# emits_per_line cap (reference EMITS_PER_LINE=20, main.cu:19).  A smaller
+# cap shrinks the Process-stage sort proportionally and is lossless iff the
+# reported overflow_tokens stays 0; the sweep's emits_per_line_ab phase
+# provides the on-hardware numbers before any default moves off 20.
+_EMITS_ENV = os.environ.get("LOCUST_BENCH_EMITS")
 _PER_BACKEND = {
     "tpu": {"block_lines": 32768, "sort_mode": "hash"},
     "cpu": {"block_lines": 16384, "sort_mode": "hash1"},
@@ -157,15 +162,18 @@ def run_bench(backend: str) -> dict:
     block_lines = (
         int(_BLOCK_LINES_ENV) if _BLOCK_LINES_ENV else defaults["block_lines"]
     )
+    emits_kw = {"emits_per_line": int(_EMITS_ENV)} if _EMITS_ENV else {}
     cfg = EngineConfig(
         block_lines=block_lines,
         sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
+        **emits_kw,
     )
     eng = MapReduceEngine(cfg)
     rows = eng.rows_from_lines(lines)
     print(
         f"[bench] corpus: {corpus_bytes/1e6:.1f} MB, {len(lines)} lines, "
         f"block_lines={block_lines}, sort_mode={cfg.sort_mode}, "
+        f"emits_per_line={cfg.emits_per_line}, "
         f"backend={jax.default_backend()}",
         file=sys.stderr,
     )
@@ -217,6 +225,8 @@ def run_bench(backend: str) -> dict:
             "lines": len(lines),
             "block_lines": block_lines,
             "sort_mode": cfg.sort_mode,
+            "emits_per_line": cfg.emits_per_line,
+            "overflow_tokens": res.overflow_tokens,
             "best_s": round(best, 4),
             "distinct": res.num_segments,
             "truncated": res.truncated,

@@ -112,6 +112,24 @@ def variant_f(lanes, values, valid):
     return jnp.sum(lanes[sidx, 0]) + jnp.sum(values[sidx].astype(jnp.uint32))
 
 
+def variant_g(lanes, values, valid):
+    """2 sort keys + payload-carry: validity folded into the top bit of a
+    31-bit primary hash (as variant D), full h2 as tiebreaker — one fewer
+    key operand than C at the same grouping guarantee (31+32 tiebreak bits;
+    the engine's segment reduce compares full lanes at boundaries anyway)."""
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    h1, h2 = packing.hash_pair(lanes)
+    key = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    out = jax.lax.sort(
+        (key, h2, *(lanes[:, i] for i in range(L)), values), num_keys=2
+    )
+    return jnp.sum(out[2]) + jnp.sum(out[-1].astype(jnp.uint32))
+
+
 VARIANTS = [
     ("A_lex9", variant_a),
     ("B_hash3_gather", variant_b),
@@ -119,6 +137,7 @@ VARIANTS = [
     ("D_hash1_gather", variant_d),
     ("E_radix4x8", variant_e),
     ("F_radix6x6", variant_f),
+    ("G_hash2_payload", variant_g),
 ]
 
 

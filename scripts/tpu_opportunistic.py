@@ -6,7 +6,7 @@ rows to ``artifacts/tpu_runs.jsonl`` via locust_tpu.utils.artifacts, so a
 partial window still leaves committed evidence.  Phases, cheapest first:
 
   1. sort-variant bench at the engine's true Process-stage shape
-     (B/C/D/E; A_lex9 is skipped — its XLA compile alone outlasts windows)
+     (B-G; A_lex9 is skipped — its XLA compile alone outlasts windows)
   2. the Pallas tokenizer check battery (scripts/tpu_checks.py inline)
   3. engine end-to-end A/B across sort modes at bench shapes
   4. (optional, $LOCUST_OPP_STREAM_MB) big-corpus streaming run in bounded
@@ -33,7 +33,7 @@ def main() -> int:
 
     # Phase 1: sort variants at the engine shape (table + block emits).
     env = dict(os.environ)
-    env["LOCUST_SORT_VARIANTS"] = "B,C,D,E,F"
+    env["LOCUST_SORT_VARIANTS"] = "B,C,D,E,F,G"
     env["N"] = str(65536 + 32768 * 20)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_sort_variants.py"),
