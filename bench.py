@@ -60,6 +60,11 @@ _SORT_MODE_ENV = os.environ.get("LOCUST_BENCH_SORT_MODE")
 # reported overflow_tokens stays 0; the sweep's emits_per_line_ab phase
 # provides the on-hardware numbers before any default moves off 20.
 _EMITS_ENV = os.environ.get("LOCUST_BENCH_EMITS")
+# key_width cap in bytes (reference key[30], KeyValue.h:15; our default 32).
+# Lossless whenever the corpus's longest token fits (hamlet: 14B); the
+# sweep's key_width_ab phase host-verifies table equality before any
+# default moves off 32.
+_KEY_WIDTH_ENV = os.environ.get("LOCUST_BENCH_KEY_WIDTH")
 _PER_BACKEND = {
     "tpu": {"block_lines": 32768, "sort_mode": "hash"},
     "cpu": {"block_lines": 16384, "sort_mode": "hash1"},
@@ -149,6 +154,31 @@ def load_corpus(target_bytes: int) -> list[bytes]:
     return lines
 
 
+def measure_caps(lines: list[bytes]) -> tuple[int, int]:
+    """One host pass: (max token bytes, max tokens/line) over the corpus.
+
+    Feeds the lossless auto-sizing of key_width / emits_per_line below:
+    capacities at the measured maxima change NOTHING about the output
+    table (no token is truncated or dropped that the default config
+    would keep), they only shrink the fixed-shape arrays every sort and
+    reduce pays for.  Deduplicated first: the bench corpus replicates a
+    base document, so unique lines are typically a small fraction.
+    """
+    import re
+
+    sys.path.insert(0, _HERE)
+    from locust_tpu.config import DELIMITERS
+
+    pat = re.compile(b"[" + re.escape(DELIMITERS) + b"]+")
+    max_tok, max_per_line = 1, 1
+    for ln in set(lines):
+        toks = [t for t in pat.split(ln) if t]
+        if toks:
+            max_per_line = max(max_per_line, len(toks))
+            max_tok = max(max_tok, max(len(t) for t in toks))
+    return max_tok, max_per_line
+
+
 def run_bench(backend: str) -> dict:
     import jax
 
@@ -162,11 +192,33 @@ def run_bench(backend: str) -> dict:
     block_lines = (
         int(_BLOCK_LINES_ENV) if _BLOCK_LINES_ENV else defaults["block_lines"]
     )
-    emits_kw = {"emits_per_line": int(_EMITS_ENV)} if _EMITS_ENV else {}
+    # Lossless capacity auto-sizing (env overrides win).  key_width=16 on
+    # hamlet: 1.72x end-to-end on CPU at an identical output table
+    # (distinct=5608 both widths).  Caps never exceed the defaults AND
+    # table_size is pinned to what the DEFAULT emits_per_line would
+    # resolve (a smaller cap would otherwise shrink
+    # resolved_table_size = min(65536, block_lines*emits_per_line) and
+    # truncate keys the default config keeps), so the result is always
+    # byte-identical to a default-config run.
+    if _EMITS_ENV and _KEY_WIDTH_ENV:
+        auto_kw, auto_epl = 32, 20  # both pinned; skip the host pass
+    else:
+        t0 = time.perf_counter()
+        max_tok, max_per_line = measure_caps(lines)
+        auto_kw = min(32, max(8, -(-max_tok // 4) * 4))
+        auto_epl = min(20, max_per_line)
+        print(
+            f"[bench] corpus caps: max_token={max_tok}B max_tokens/line="
+            f"{max_per_line} -> key_width={auto_kw} emits_per_line={auto_epl} "
+            f"({time.perf_counter()-t0:.1f}s)",
+            file=sys.stderr,
+        )
     cfg = EngineConfig(
         block_lines=block_lines,
         sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
-        **emits_kw,
+        emits_per_line=int(_EMITS_ENV) if _EMITS_ENV else auto_epl,
+        key_width=int(_KEY_WIDTH_ENV) if _KEY_WIDTH_ENV else auto_kw,
+        table_size=EngineConfig(block_lines=block_lines).resolved_table_size,
     )
     eng = MapReduceEngine(cfg)
     rows = eng.rows_from_lines(lines)
@@ -226,6 +278,7 @@ def run_bench(backend: str) -> dict:
             "block_lines": block_lines,
             "sort_mode": cfg.sort_mode,
             "emits_per_line": cfg.emits_per_line,
+            "key_width": cfg.key_width,
             "overflow_tokens": res.overflow_tokens,
             "best_s": round(best, 4),
             "distinct": res.num_segments,

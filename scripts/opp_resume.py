@@ -198,6 +198,52 @@ def phase_emits_ab(rows_ab, corpus_bytes) -> None:
     )
 
 
+def phase_key_width_ab(rows_ab, corpus_bytes) -> None:
+    """key_width A/B at the headline-bench shape.
+
+    The reference caps keys at 30 bytes (KeyValue.h:15); our default
+    rounds to 32 = 8 uint32 lanes.  Every sort mode carries (or gathers)
+    all key lanes per row, so a corpus whose longest token fits 16 bytes
+    halves that traffic at key_width=16 with ZERO semantic change —
+    verified here by comparing the decoded host table against the
+    32-byte-width run, not just the distinct count.  (hamlet max token:
+    14 bytes; the Zipf generator's: 7.)
+    """
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.engine import MapReduceEngine
+    from locust_tpu.utils import artifacts
+
+    results = {}
+    baseline_pairs = None
+    blocks = None  # staged once: line blocks don't depend on key_width
+    for kw in (32, 16):
+        eng = MapReduceEngine(
+            EngineConfig(block_lines=32768, key_width=kw)
+        )
+        if blocks is None:
+            blocks = eng.prepare_blocks(rows_ab)
+            blocks.block_until_ready()
+        eng.run_blocks(blocks)  # compile + warm
+        best, res = float("inf"), None
+        for _ in range(3):
+            res = eng.run_blocks(blocks)
+            best = min(best, res.times.total_ms / 1e3)
+        pairs = res.to_host_pairs()
+        if baseline_pairs is None:
+            baseline_pairs = pairs
+        results[str(kw)] = {
+            "mb_s": round(corpus_bytes / 1e6 / best, 2),
+            "best_s": round(best, 4),
+            "distinct": res.num_segments,
+            "table_exact_vs_32": pairs == baseline_pairs,
+        }
+        print(f"[opp] key_width={kw}: {results[str(kw)]}", file=sys.stderr)
+    artifacts.record(
+        "key_width_ab",
+        {"corpus_mb": round(corpus_bytes / 1e6, 1), "widths": results},
+    )
+
+
 def phase_stream() -> None:
     """Optional ($LOCUST_OPP_STREAM_MB) big streaming corpus in bounded RSS."""
     stream_mb = int(os.environ.get("LOCUST_OPP_STREAM_MB", 0))
@@ -237,6 +283,7 @@ def run_phases() -> None:
     phase_sort_mode_ab(rows_ab, corpus_bytes)
     phase_block_lines(rows_ab, corpus_bytes)
     phase_emits_ab(rows_ab, corpus_bytes)
+    phase_key_width_ab(rows_ab, corpus_bytes)
     phase_stream()
 
 
