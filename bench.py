@@ -154,31 +154,6 @@ def load_corpus(target_bytes: int) -> list[bytes]:
     return lines
 
 
-def measure_caps(lines: list[bytes]) -> tuple[int, int]:
-    """One host pass: (max token bytes, max tokens/line) over the corpus.
-
-    Feeds the lossless auto-sizing of key_width / emits_per_line below:
-    capacities at the measured maxima change NOTHING about the output
-    table (no token is truncated or dropped that the default config
-    would keep), they only shrink the fixed-shape arrays every sort and
-    reduce pays for.  Deduplicated first: the bench corpus replicates a
-    base document, so unique lines are typically a small fraction.
-    """
-    import re
-
-    sys.path.insert(0, _HERE)
-    from locust_tpu.config import DELIMITERS
-
-    pat = re.compile(b"[" + re.escape(DELIMITERS) + b"]+")
-    max_tok, max_per_line = 1, 1
-    for ln in set(lines):
-        toks = [t for t in pat.split(ln) if t]
-        if toks:
-            max_per_line = max(max_per_line, len(toks))
-            max_tok = max(max_tok, max(len(t) for t in toks))
-    return max_tok, max_per_line
-
-
 def run_bench(backend: str) -> dict:
     import jax
 
@@ -203,10 +178,10 @@ def run_bench(backend: str) -> dict:
     if _EMITS_ENV and _KEY_WIDTH_ENV:
         auto_kw, auto_epl = 32, 20  # both pinned; skip the host pass
     else:
+        from locust_tpu.io.loader import auto_caps
+
         t0 = time.perf_counter()
-        max_tok, max_per_line = measure_caps(lines)
-        auto_kw = min(32, max(8, -(-max_tok // 4) * 4))
-        auto_epl = min(20, max_per_line)
+        auto_kw, auto_epl, max_tok, max_per_line = auto_caps(lines, 32, 20)
         print(
             f"[bench] corpus caps: max_token={max_tok}B max_tokens/line="
             f"{max_per_line} -> key_width={auto_kw} emits_per_line={auto_epl} "

@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--line-width", type=int, default=128)
     p.add_argument("--key-width", type=int, default=32)
     p.add_argument("--emits-per-line", type=int, default=20)
+    p.add_argument("--auto-caps", action="store_true",
+                   help="size key_width / emits_per_line to the corpus's "
+                        "measured maxima (one host pass; lossless — output "
+                        "identical to the configured caps, smaller sorted "
+                        "arrays).  Ignored with --stream (would need a "
+                        "second pass over the file) and for stage 2.")
     p.add_argument("--no-timing", action="store_true")
     p.add_argument("--limit", type=int, default=None,
                    help="print only the first N table rows")
@@ -139,8 +145,6 @@ def _run(args) -> int:
         emits_per_line=args.emits_per_line,
         sort_mode=args.sort_mode,
     )
-    eng = MapReduceEngine(cfg)
-    inter = args.intermediate or [DEFAULT_INTERMEDIATE]
 
     # --trace / --profile-dir wire the hardening utils (SURVEY.md §5
     # tracing): wall-clock spans + optional XLA profiler capture.
@@ -155,8 +159,52 @@ def _run(args) -> int:
         else contextlib.nullcontext()
     )
 
+    # --auto-caps: measure the corpus once and shrink key_width /
+    # emits_per_line to their lossless floors (never above the flags);
+    # table_size pins to the flag-config resolution so the output table is
+    # byte-identical either way (see bench.py, 1.7x CPU on hamlet).
+    # SpanTimer spans accumulate per name, so this preload bills to the
+    # same "load" span the main path uses.
+    preloaded_rows = None
+    if args.auto_caps and args.stage in (STAGE_SINGLE, STAGE_MAP):
+        if args.stream:
+            print("[locust] --auto-caps ignored with --stream "
+                  "(needs a second pass over the file)", file=sys.stderr)
+        else:
+            import dataclasses
+
+            with timer.span("load"):
+                preloaded_rows = loader.load_rows(
+                    args.filename, cfg.line_width,
+                    args.line_start, args.line_end,
+                )
+                # Measured on the width-truncated rows the engine will
+                # actually see (full row bytes, NOT NUL-truncated: an
+                # embedded NUL is a token boundary to the device
+                # tokenizer and post-NUL tokens still count).
+                kw, epl, max_tok, max_per_line = loader.auto_caps(
+                    [r.tobytes() for r in preloaded_rows],
+                    cfg.key_width,
+                    cfg.emits_per_line,
+                )
+            cfg = dataclasses.replace(
+                cfg,
+                key_width=kw,
+                emits_per_line=epl,
+                table_size=cfg.resolved_table_size,
+            )
+            print(
+                f"[locust] auto-caps: max_token={max_tok}B "
+                f"max_tokens/line={max_per_line} -> key_width="
+                f"{cfg.key_width} emits_per_line={cfg.emits_per_line}",
+                file=sys.stderr,
+            )
+
+    eng = MapReduceEngine(cfg)
+    inter = args.intermediate or [DEFAULT_INTERMEDIATE]
+
     if args.mesh and args.stage in (STAGE_SINGLE, STAGE_MAP):
-        rc = _run_mesh(args, cfg, timer, prof)
+        rc = _run_mesh(args, cfg, timer, prof, preloaded_rows)
         if args.trace:
             print(timer.report(), file=sys.stderr)
         return rc
@@ -171,8 +219,13 @@ def _run(args) -> int:
                         args.line_start, args.line_end,
                     )
                 else:
-                    rows = loader.load_rows(
-                        args.filename, cfg.line_width, args.line_start, args.line_end
+                    rows = (
+                        preloaded_rows
+                        if preloaded_rows is not None
+                        else loader.load_rows(
+                            args.filename, cfg.line_width,
+                            args.line_start, args.line_end,
+                        )
                     )
                     print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
             with timer.span("run"):
@@ -258,7 +311,7 @@ def _run(args) -> int:
     return 0
 
 
-def _run_mesh(args, cfg, timer, prof) -> int:
+def _run_mesh(args, cfg, timer, prof, preloaded_rows=None) -> int:
     """Stage 0/1 over ALL visible devices: the CLI face of the mesh engine.
 
     The reference's distributed mode is CLI-driven (main.cu:358-387,
@@ -326,8 +379,13 @@ def _run_mesh(args, cfg, timer, prof) -> int:
                 if args.checkpoint_dir:
                     kw["fingerprint"] = stream.fingerprint()
             else:
-                rows = loader.load_rows(
-                    args.filename, cfg.line_width, args.line_start, args.line_end
+                rows = (
+                    preloaded_rows
+                    if preloaded_rows is not None
+                    else loader.load_rows(
+                        args.filename, cfg.line_width,
+                        args.line_start, args.line_end,
+                    )
                 )
                 print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
         with timer.span("run"):

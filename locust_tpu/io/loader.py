@@ -50,6 +50,53 @@ def load_lines(
     return lines[start:end]
 
 
+def measure_caps(lines) -> tuple[int, int]:
+    """One host pass: (max token bytes, max tokens per line) over ``lines``.
+
+    Feeds lossless capacity auto-sizing (``auto_caps`` below):
+    ``key_width`` / ``emits_per_line`` set to these maxima change NOTHING
+    about the output table relative to any larger caps — no token is
+    truncated or dropped that the larger config would keep — they only
+    shrink the fixed-shape arrays every sort and reduce pays for.
+
+    Splits on the ENGINE's full delimiter set — ``DELIMITERS`` plus
+    ``\\x00\\n\\r`` (core/bytes_ops.delimiter_mask) — not just the strtok
+    set: a mid-line ``\\r`` or embedded NUL is data to the loader but a
+    token boundary to the device tokenizer, and undercounting tokens
+    here would let an auto-sized ``emits_per_line`` drop real emits.
+    Deduplicates first: replicated corpora (the bench's) measure each
+    unique line once.
+    """
+    import re
+
+    from locust_tpu.config import DELIMITERS
+
+    pat = re.compile(b"[" + re.escape(DELIMITERS + b"\x00\n\r") + b"]+")
+    max_tok, max_per_line = 1, 1
+    for ln in set(lines):
+        toks = [t for t in pat.split(ln) if t]
+        if toks:
+            max_per_line = max(max_per_line, len(toks))
+            max_tok = max(max_tok, max(len(t) for t in toks))
+    return max_tok, max_per_line
+
+
+def auto_caps(lines, key_cap: int, emits_cap: int) -> tuple[int, int, int, int]:
+    """Lossless capacity sizing: the single policy behind bench.py and
+    ``--auto-caps`` (cli.py).
+
+    Returns ``(key_width, emits_per_line, max_tok, max_per_line)`` with
+    the caps at their measured lossless floors — max token bytes rounded
+    up to a uint32 lane multiple (floor 8), max tokens/line — but never
+    above the caller's ``key_cap`` / ``emits_cap``, so the output table
+    is byte-identical to a run at the original caps.
+    """
+    max_tok, max_per_line = measure_caps(lines)
+    kw = min(key_cap, max(8, -(-max_tok // 4) * 4))
+    epl = min(emits_cap, max_per_line)
+    return kw, epl, max_tok, max_per_line
+
+
 def count_lines(path: str) -> int:
     """Streaming line count (O(1) memory; multi-GB corpora are fine).
 
