@@ -95,8 +95,13 @@ def _staged_rows():
     return rows, corpus_bytes
 
 
-def phase_sort_mode_ab(rows_ab, corpus_bytes) -> None:
-    """Engine end-to-end per sort mode at bench shapes."""
+def phase_sort_mode_ab(rows_ab, corpus_bytes) -> str:
+    """Engine end-to-end per sort mode at bench shapes.
+
+    Returns the winning mode so phase_block_lines sweeps AT that mode —
+    bench.py only adopts a (sort_mode, block_lines) pair a window
+    actually measured together.
+    """
     from locust_tpu.config import EngineConfig
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
@@ -124,18 +129,21 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes) -> None:
         "engine_sort_mode_ab",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "modes": results},
     )
+    return max(results, key=lambda m: results[m]["mb_s"])
 
 
-def phase_block_lines(rows_ab, corpus_bytes) -> None:
+def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash") -> None:
     """block_lines tuning at the headline-bench shape — dispatch granularity
-    vs per-block sort size is the one free knob left."""
+    vs per-block sort size is the one free knob left.  Swept at
+    ``sort_mode`` (the phase-3 winner) and the row records it, so the
+    (sort_mode, block_lines) pair bench.py adopts was measured jointly."""
     from locust_tpu.config import EngineConfig
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
     results = {}
     for bl in (16384, 32768, 65536):
-        eng = MapReduceEngine(EngineConfig(block_lines=bl))
+        eng = MapReduceEngine(EngineConfig(block_lines=bl, sort_mode=sort_mode))
         blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
         eng.run_blocks(blocks)  # compile + warm
@@ -150,7 +158,8 @@ def phase_block_lines(rows_ab, corpus_bytes) -> None:
         print(f"[opp] block_lines={bl}: {results[str(bl)]}", file=sys.stderr)
     artifacts.record(
         "block_lines_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "blocks": results},
+        {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
+         "blocks": results},
     )
 
 
@@ -280,8 +289,8 @@ def run_phases() -> None:
     """Phases 2.5 -> 4, in the order the full sweep runs them."""
     phase_stage_parity()
     rows_ab, corpus_bytes = _staged_rows()
-    phase_sort_mode_ab(rows_ab, corpus_bytes)
-    phase_block_lines(rows_ab, corpus_bytes)
+    winner = phase_sort_mode_ab(rows_ab, corpus_bytes)
+    phase_block_lines(rows_ab, corpus_bytes, sort_mode=winner)
     phase_emits_ab(rows_ab, corpus_bytes)
     phase_key_width_ab(rows_ab, corpus_bytes)
     phase_stream()

@@ -128,6 +128,69 @@ def test_main_routes_inner_and_orchestrator(monkeypatch):
     assert seen.get("o") is True
 
 
+def test_evidence_tuned_tpu_defaults(tmp_path, monkeypatch, capsys):
+    """The latest committed A/B rows steer the TPU defaults (argmax MB/s);
+    absent rows leave the static defaults untouched."""
+    static = {"block_lines": 32768, "sort_mode": "hash"}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    assert bench._evidence_tuned_tpu_defaults(static) == static
+
+    rows = [
+        {"kind": "engine_sort_mode_ab", "backend": "tpu",
+         "modes": {"hash": {"mb_s": 30.0}, "hashp": {"mb_s": 41.0},
+                   "radix": {"mb_s": 12.0}}},
+        {"kind": "block_lines_ab", "backend": "tpu",
+         "blocks": {"16384": {"mb_s": 33.0}, "32768": {"mb_s": 39.0},
+                    "65536": {"mb_s": 35.0}}},
+        # A later losing-row update must supersede the earlier one.
+        {"kind": "engine_sort_mode_ab", "backend": "tpu",
+         "modes": {"hash": {"mb_s": 35.0}, "hashp2": {"mb_s": 44.0}}},
+        # CPU rows of the same kind are ignored.
+        {"kind": "engine_sort_mode_ab", "backend": "cpu",
+         "modes": {"lex": {"mb_s": 999.0}}},
+    ]
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    # block_lines row swept at "hash" (no sort_mode field => historical
+    # default) but the adopted mode is hashp2 -> block size NOT adopted:
+    # only jointly-measured pairs are trusted.
+    assert tuned == {"block_lines": 32768, "sort_mode": "hashp2"}
+
+    # A block row recorded AT the winning mode IS adopted.
+    with open(tmp_path / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu",
+             "sort_mode": "hashp2",
+             "blocks": {"16384": {"mb_s": 45.0}, "32768": {"mb_s": 40.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned == {"block_lines": 16384, "sort_mode": "hashp2"}
+
+
+def test_evidence_tuning_survives_malformed_rows(tmp_path, monkeypatch, capsys):
+    """Evidence must never break a run: a null-mode row (exactly what
+    artifacts.record's exception fallback can append) or an unknown sort
+    mode falls back to the static defaults instead of crashing the TPU
+    child before it even probes."""
+    static = {"block_lines": 32768, "sort_mode": "hash"}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hash": None}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static) == static
+
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"mode_deleted_in_v9": {"mb_s": 99.0}}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static) == static
+
+
 def test_error_payload_shape():
     row = bench.error_payload("boom")
     assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}
