@@ -54,8 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size key_width / emits_per_line to the corpus's "
                         "measured maxima (one host pass; lossless — output "
                         "identical to the configured caps, smaller sorted "
-                        "arrays).  Ignored with --stream (would need a "
-                        "second pass over the file) and for stage 2.")
+                        "arrays).  With --stream the measuring pass re-reads "
+                        "the file in bounded memory.  No effect for stage 2.")
     p.add_argument("--no-timing", action="store_true")
     p.add_argument("--limit", type=int, default=None,
                    help="print only the first N table rows")
@@ -166,14 +166,27 @@ def _run(args) -> int:
     # SpanTimer spans accumulate per name, so this preload bills to the
     # same "load" span the main path uses.
     preloaded_rows = None
+    auto_caps_fp = None  # stream identity at measure time (checked at run)
     if args.auto_caps and args.stage in (STAGE_SINGLE, STAGE_MAP):
-        if args.stream:
-            print("[locust] --auto-caps ignored with --stream "
-                  "(needs a second pass over the file)", file=sys.stderr)
-        else:
-            import dataclasses
+        import dataclasses
 
-            with timer.span("load"):
+        with timer.span("load"):
+            if args.stream:
+                # Bounded-memory measuring pass: the file is read twice
+                # (measure, then run) but never materialized — the caps
+                # win usually dwarfs the extra host read on device-bound
+                # streaming runs.  The fingerprint pins the file identity
+                # so a corpus mutated between the passes is caught
+                # instead of silently under-sizing the caps.
+                measure_stream = loader.StreamingCorpus(
+                    args.filename, cfg.line_width, cfg.block_lines,
+                    args.line_start, args.line_end,
+                )
+                auto_caps_fp = measure_stream.fingerprint()
+                max_tok, max_per_line = loader.measure_caps_rows(
+                    measure_stream
+                )
+            else:
                 preloaded_rows = loader.load_rows(
                     args.filename, cfg.line_width,
                     args.line_start, args.line_end,
@@ -182,29 +195,30 @@ def _run(args) -> int:
                 # actually see (full row bytes, NOT NUL-truncated: an
                 # embedded NUL is a token boundary to the device
                 # tokenizer and post-NUL tokens still count).
-                kw, epl, max_tok, max_per_line = loader.auto_caps(
-                    [r.tobytes() for r in preloaded_rows],
-                    cfg.key_width,
-                    cfg.emits_per_line,
+                max_tok, max_per_line = loader.measure_caps(
+                    [r.tobytes() for r in preloaded_rows]
                 )
-            cfg = dataclasses.replace(
-                cfg,
-                key_width=kw,
-                emits_per_line=epl,
-                table_size=cfg.resolved_table_size,
-            )
-            print(
-                f"[locust] auto-caps: max_token={max_tok}B "
-                f"max_tokens/line={max_per_line} -> key_width="
-                f"{cfg.key_width} emits_per_line={cfg.emits_per_line}",
-                file=sys.stderr,
-            )
+        kw, epl = loader.size_caps(
+            max_tok, max_per_line, cfg.key_width, cfg.emits_per_line
+        )
+        cfg = dataclasses.replace(
+            cfg,
+            key_width=kw,
+            emits_per_line=epl,
+            table_size=cfg.resolved_table_size,
+        )
+        print(
+            f"[locust] auto-caps: max_token={max_tok}B "
+            f"max_tokens/line={max_per_line} -> key_width="
+            f"{cfg.key_width} emits_per_line={cfg.emits_per_line}",
+            file=sys.stderr,
+        )
 
     eng = MapReduceEngine(cfg)
     inter = args.intermediate or [DEFAULT_INTERMEDIATE]
 
     if args.mesh and args.stage in (STAGE_SINGLE, STAGE_MAP):
-        rc = _run_mesh(args, cfg, timer, prof, preloaded_rows)
+        rc = _run_mesh(args, cfg, timer, prof, preloaded_rows, auto_caps_fp)
         if args.trace:
             print(timer.report(), file=sys.stderr)
         return rc
@@ -218,6 +232,8 @@ def _run(args) -> int:
                         args.filename, cfg.line_width, cfg.block_lines,
                         args.line_start, args.line_end,
                     )
+                    if _stale_auto_caps(stream, auto_caps_fp):
+                        return 1
                 else:
                     rows = (
                         preloaded_rows
@@ -311,7 +327,23 @@ def _run(args) -> int:
     return 0
 
 
-def _run_mesh(args, cfg, timer, prof, preloaded_rows=None) -> int:
+def _stale_auto_caps(stream, auto_caps_fp) -> bool:
+    """True (and prints the error) if the corpus changed between the
+    --auto-caps measuring pass and the run pass — under-sized caps would
+    silently truncate or drop the new content's tokens otherwise."""
+    if auto_caps_fp is None or stream.fingerprint() == auto_caps_fp:
+        return False
+    print(
+        "mapreduce: error: corpus changed between the --auto-caps "
+        "measuring pass and the run; re-run (or drop --auto-caps for a "
+        "file that is being written to)",
+        file=sys.stderr,
+    )
+    return True
+
+
+def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
+              auto_caps_fp=None) -> int:
     """Stage 0/1 over ALL visible devices: the CLI face of the mesh engine.
 
     The reference's distributed mode is CLI-driven (main.cu:358-387,
@@ -376,6 +408,8 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None) -> int:
                     args.filename, cfg.line_width, dmr.lines_per_round,
                     args.line_start, args.line_end,
                 )
+                if _stale_auto_caps(stream, auto_caps_fp):
+                    return 1
                 if args.checkpoint_dir:
                     kw["fingerprint"] = stream.fingerprint()
             else:

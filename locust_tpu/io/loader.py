@@ -81,6 +81,16 @@ def measure_caps(lines) -> tuple[int, int]:
     return max_tok, max_per_line
 
 
+def size_caps(
+    max_tok: int, max_per_line: int, key_cap: int, emits_cap: int
+) -> tuple[int, int]:
+    """The one lossless sizing rule: measured maxima, lane-rounded key
+    width (floor 8), never above the caller's caps."""
+    kw = min(key_cap, max(8, -(-max_tok // 4) * 4))
+    epl = min(emits_cap, max_per_line)
+    return kw, epl
+
+
 def auto_caps(lines, key_cap: int, emits_cap: int) -> tuple[int, int, int, int]:
     """Lossless capacity sizing: the single policy behind bench.py and
     ``--auto-caps`` (cli.py).
@@ -92,9 +102,42 @@ def auto_caps(lines, key_cap: int, emits_cap: int) -> tuple[int, int, int, int]:
     is byte-identical to a run at the original caps.
     """
     max_tok, max_per_line = measure_caps(lines)
-    kw = min(key_cap, max(8, -(-max_tok // 4) * 4))
-    epl = min(emits_cap, max_per_line)
+    kw, epl = size_caps(max_tok, max_per_line, key_cap, emits_cap)
     return kw, epl, max_tok, max_per_line
+
+
+def measure_caps_rows(row_blocks) -> tuple[int, int]:
+    """Bounded-memory (max token bytes, max tokens per line) over an
+    iterable of padded ``[n, width]`` uint8 row blocks.
+
+    The streaming analog of ``measure_caps`` — vectorized numpy per
+    block, no dedup set, O(block) memory — so ``--auto-caps`` composes
+    with ``--stream`` on corpora that don't fit RAM.  Tokenizes exactly
+    as the device does: the full delimiter set incl. NUL (so the padding
+    contributes nothing), scanning column-by-column (width ~128 steps of
+    whole-block vector ops).
+    """
+    from locust_tpu.config import DELIMITERS
+
+    lut = np.zeros(256, dtype=bool)
+    for b in DELIMITERS + b"\x00\n\r":
+        lut[b] = True
+    max_tok, max_per_line = 1, 1
+    for blk in row_blocks:
+        rows = np.asarray(blk, dtype=np.uint8)
+        if rows.size == 0:
+            continue
+        is_delim = lut[rows]                        # [n, w] bool
+        starts = ~is_delim
+        starts[:, 1:] &= is_delim[:, :-1]           # non-delim after delim
+        max_per_line = max(max_per_line, int(starts.sum(axis=1).max()))
+        run = np.zeros(rows.shape[0], dtype=np.int32)
+        longest = np.zeros(rows.shape[0], dtype=np.int32)
+        for c in range(rows.shape[1]):              # width steps, vector rows
+            run = np.where(is_delim[:, c], 0, run + 1)
+            np.maximum(longest, run, out=longest)
+        max_tok = max(max_tok, int(longest.max()))
+    return max_tok, max_per_line
 
 
 def count_lines(path: str) -> int:

@@ -96,6 +96,28 @@ def test_cli_auto_caps_output_identical(corpus_file, capsysbinary):
     assert _parse_table(auto) == dict(py_wordcount(CORPUS.splitlines(), 8))
 
 
+def test_cli_auto_caps_stream_detects_corpus_mutation(tmp_path, monkeypatch,
+                                                      capsysbinary):
+    """A corpus rewritten between the measuring pass and the run must be
+    caught (under-sized caps would silently drop the new tokens)."""
+    import locust_tpu.io.loader as loader_mod
+
+    p = tmp_path / "in.txt"
+    p.write_bytes(CORPUS)
+    orig = loader_mod.measure_caps_rows
+
+    def measure_then_mutate(blocks):
+        out = orig(blocks)
+        p.write_bytes(CORPUS + b"appended muchlongertokenthanmeasured line\n")
+        return out
+
+    monkeypatch.setattr(loader_mod, "measure_caps_rows", measure_then_mutate)
+    rc = cli.main([str(p), "--stream", "--auto-caps"] + _cfg_args())
+    assert rc == 1
+    out, err = capsysbinary.readouterr()
+    assert b"corpus changed" in err
+
+
 def test_cli_auto_caps_lossless_on_cr_and_nul(tmp_path, capsysbinary):
     """A mid-line \\r (or NUL) is data to the loader but a token boundary
     to the device tokenizer; auto-caps must count tokens the engine's way
@@ -122,13 +144,17 @@ def test_cli_auto_caps_mesh_matches_oracle(corpus_file, capsysbinary):
     assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
 
 
-def test_cli_auto_caps_ignored_with_stream(corpus_file, capsysbinary):
+def test_cli_auto_caps_with_stream(corpus_file, capsysbinary):
+    """--auto-caps composes with --stream via the bounded-memory
+    measuring pass; output identical to a plain --stream run."""
+    assert cli.main([corpus_file, "--stream"] + _cfg_args()) == 0
+    plain = capsysbinary.readouterr().out
     rc = cli.main([corpus_file, "--stream", "--auto-caps"] + _cfg_args())
     assert rc == 0
     out, err = capsysbinary.readouterr()
-    assert b"--auto-caps ignored" in err
-    got = _parse_table(out)
-    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+    assert b"auto-caps:" in err
+    assert out == plain
+    assert _parse_table(out) == dict(py_wordcount(CORPUS.splitlines(), 8))
 
 
 def test_cli_mesh_mode_matches_oracle(corpus_file, capsysbinary):

@@ -279,3 +279,44 @@ class TestNativeTsvParity:
         np.testing.assert_array_equal(nk, pk)
         np.testing.assert_array_equal(nv, pv)
         assert len(nv) == 5000
+
+
+class TestMeasureCaps:
+    """measure_caps (regex over lines) and measure_caps_rows (vectorized
+    over padded row blocks) must agree — cli.py --auto-caps uses one for
+    materialized runs and the other for --stream."""
+
+    def test_rows_variant_matches_regex_oracle(self):
+        rng = np.random.default_rng(7)
+        from locust_tpu.config import DELIMITERS
+        from locust_tpu.io.loader import measure_caps, measure_caps_rows
+
+        alphabet = b"abcdefgh" + DELIMITERS[:4] + b"\r"
+        for trial in range(20):
+            n = int(rng.integers(1, 40))
+            lines = [
+                bytes(alphabet[i] for i in rng.integers(0, len(alphabet), size=int(rng.integers(0, 60))))
+                for _ in range(n)
+            ]
+            width = int(rng.choice([16, 32, 64]))
+            rows = bytes_ops.strings_to_rows(lines, width)
+            # The regex oracle must see the same width-truncated view.
+            got = measure_caps_rows([rows[:n // 2], rows[n // 2:]])
+            want = measure_caps([ln[:width] for ln in lines])
+            assert got == want, f"trial={trial} width={width}"
+
+    def test_rows_variant_counts_post_nul_tokens(self):
+        from locust_tpu.io.loader import measure_caps, measure_caps_rows
+
+        # Embedded NUL: loader keeps it as data; the device tokenizer
+        # splits there.  Both measures must count 2 tokens.
+        rows = bytes_ops.strings_to_rows([b"abc\x00defgh"], 16)
+        assert measure_caps_rows([rows]) == (5, 2)
+        assert measure_caps([b"abc\x00defgh"]) == (5, 2)
+
+    def test_empty_and_all_delim_blocks(self):
+        from locust_tpu.io.loader import measure_caps_rows
+
+        assert measure_caps_rows([]) == (1, 1)
+        rows = bytes_ops.strings_to_rows([b"", b" , .", b"\t\t"], 8)
+        assert measure_caps_rows([rows]) == (1, 1)
