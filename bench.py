@@ -65,9 +65,13 @@ _EMITS_ENV = os.environ.get("LOCUST_BENCH_EMITS")
 # sweep's key_width_ab phase host-verifies table equality before any
 # default moves off 32.
 _KEY_WIDTH_ENV = os.environ.get("LOCUST_BENCH_KEY_WIDTH")
+# "0"/"1": force the Pallas map kernel off/on, overriding both the static
+# default and any evidence-tuned flip (the escape hatch every other tuned
+# knob already has via its LOCUST_BENCH_* var).
+_PALLAS_ENV = os.environ.get("LOCUST_BENCH_PALLAS")
 _PER_BACKEND = {
-    "tpu": {"block_lines": 32768, "sort_mode": "hash"},
-    "cpu": {"block_lines": 16384, "sort_mode": "hash1"},
+    "tpu": {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False},
+    "cpu": {"block_lines": 16384, "sort_mode": "hash1", "use_pallas": False},
 }
 TIMEOUT_S = float(os.environ.get("LOCUST_BENCH_TIMEOUT", 1200))
 # Wall-clock reserved for the final CPU fallback when the retry loop gives
@@ -177,6 +181,27 @@ def _evidence_tuned_tpu_defaults(defaults: dict) -> dict:
                     f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
                     file=sys.stderr,
                 )
+        # use_pallas: adopt only a measured engine-level win, and only if
+        # the row was swept AT the adopted (sort_mode, block_lines) —
+        # same joint-measurement rule as above.  A side that errored has
+        # no "mb_s" key and loses.
+        pa = _tpu_rows("engine_pallas_ab")
+        if pa:
+            row = pa[-1]
+            joint = (
+                row.get("sort_mode", "hash") == out["sort_mode"]
+                and int(row.get("block_lines", 32768)) == out["block_lines"]
+            )
+            sides = row.get("pallas", {})
+            on = (sides.get("True") or {}).get("mb_s", 0.0)
+            off = (sides.get("False") or {}).get("mb_s", 0.0)
+            if joint and on > off > 0.0:
+                out["use_pallas"] = True
+                print(
+                    f"[bench] evidence-tuned use_pallas=True "
+                    f"({on} vs {off} MB/s in the last TPU A/B)",
+                    file=sys.stderr,
+                )
     except Exception as e:  # noqa: BLE001 - tuning is best-effort
         print(
             f"[bench] evidence tuning skipped ({type(e).__name__}: {e}); "
@@ -261,6 +286,11 @@ def run_bench(backend: str) -> dict:
         sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
         emits_per_line=int(_EMITS_ENV) if _EMITS_ENV else auto_epl,
         key_width=int(_KEY_WIDTH_ENV) if _KEY_WIDTH_ENV else auto_kw,
+        use_pallas=(
+            _PALLAS_ENV == "1"
+            if _PALLAS_ENV is not None
+            else defaults.get("use_pallas", False)
+        ),
         table_size=EngineConfig(block_lines=block_lines).resolved_table_size,
     )
     eng = MapReduceEngine(cfg)

@@ -31,6 +31,14 @@ SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix", "lex")
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.
 PAD_BYTE: int = 0
 
+# Bytes that are token boundaries on DEVICE beyond the strtok set: NUL (row
+# padding / embedded NULs) and the newline pair.  The single source for
+# every host-side measure that must count tokens the device's way
+# (core/bytes_ops.delimiter_mask, io/loader.measure_caps*) — three drifting
+# copies of this literal would let --auto-caps under-size emits_per_line.
+TOKEN_BOUNDARY_EXTRA: bytes = b"\x00\n\r"
+FULL_DELIMITERS: bytes = DELIMITERS + TOKEN_BOUNDARY_EXTRA
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:

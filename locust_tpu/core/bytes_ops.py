@@ -50,7 +50,9 @@ def delimiter_mask(x: jax.Array, delimiters: bytes = DELIMITERS) -> jax.Array:
     pad byte and newline/carriage-return, which in the reference never reach
     strtok because tokenization is per-getline-line.
     """
-    delims = np.frombuffer(delimiters + b"\x00\n\r", dtype=np.uint8)
+    from locust_tpu.config import TOKEN_BOUNDARY_EXTRA
+
+    delims = np.frombuffer(delimiters + TOKEN_BOUNDARY_EXTRA, dtype=np.uint8)
     # Small membership test: [..., W, D] compare then any-reduce. D is ~13 so
     # this stays cheap and fuses into one VPU pass.
     return jnp.any(x[..., None] == jnp.asarray(delims), axis=-1)

@@ -69,9 +69,9 @@ def measure_caps(lines) -> tuple[int, int]:
     """
     import re
 
-    from locust_tpu.config import DELIMITERS
+    from locust_tpu.config import FULL_DELIMITERS
 
-    pat = re.compile(b"[" + re.escape(DELIMITERS + b"\x00\n\r") + b"]+")
+    pat = re.compile(b"[" + re.escape(FULL_DELIMITERS) + b"]+")
     max_tok, max_per_line = 1, 1
     for ln in set(lines):
         toks = [t for t in pat.split(ln) if t]
@@ -117,10 +117,10 @@ def measure_caps_rows(row_blocks) -> tuple[int, int]:
     contributes nothing), scanning column-by-column (width ~128 steps of
     whole-block vector ops).
     """
-    from locust_tpu.config import DELIMITERS
+    from locust_tpu.config import FULL_DELIMITERS
 
     lut = np.zeros(256, dtype=bool)
-    for b in DELIMITERS + b"\x00\n\r":
+    for b in FULL_DELIMITERS:
         lut[b] = True
     max_tok, max_per_line = 1, 1
     for blk in row_blocks:

@@ -131,7 +131,7 @@ def test_main_routes_inner_and_orchestrator(monkeypatch):
 def test_evidence_tuned_tpu_defaults(tmp_path, monkeypatch, capsys):
     """The latest committed A/B rows steer the TPU defaults (argmax MB/s);
     absent rows leave the static defaults untouched."""
-    static = {"block_lines": 32768, "sort_mode": "hash"}
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
     monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
     assert bench._evidence_tuned_tpu_defaults(static) == static
 
@@ -156,17 +156,48 @@ def test_evidence_tuned_tpu_defaults(tmp_path, monkeypatch, capsys):
     # block_lines row swept at "hash" (no sort_mode field => historical
     # default) but the adopted mode is hashp2 -> block size NOT adopted:
     # only jointly-measured pairs are trusted.
-    assert tuned == {"block_lines": 32768, "sort_mode": "hashp2"}
+    assert tuned == {"block_lines": 32768, "sort_mode": "hashp2",
+                     "use_pallas": False}
 
-    # A block row recorded AT the winning mode IS adopted.
+    # A block row recorded AT the winning mode IS adopted; a Pallas A/B
+    # win flips use_pallas (an errored side has no mb_s and loses).
     with open(tmp_path / "tpu_runs.jsonl", "a") as f:
         f.write(json.dumps(
             {"kind": "block_lines_ab", "backend": "tpu",
              "sort_mode": "hashp2",
              "blocks": {"16384": {"mb_s": 45.0}, "32768": {"mb_s": 40.0}}}
         ) + "\n")
+        # Measured at a DIFFERENT config -> not adopted (joint rule)...
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hash", "block_lines": 32768,
+             "pallas": {"False": {"mb_s": 40.0}, "True": {"mb_s": 43.0}}}
+        ) + "\n")
     tuned = bench._evidence_tuned_tpu_defaults(static)
-    assert tuned == {"block_lines": 16384, "sort_mode": "hashp2"}
+    assert tuned["use_pallas"] is False
+
+    # ...but a win measured AT the adopted (sort_mode, block_lines) is.
+    with open(tmp_path / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hashp2", "block_lines": 16384,
+             "pallas": {"False": {"mb_s": 40.0}, "True": {"mb_s": 43.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned == {"block_lines": 16384, "sort_mode": "hashp2",
+                     "use_pallas": True}
+
+    # Pallas side errored (no mb_s) -> flag stays off even at the
+    # matching configuration.
+    with open(tmp_path / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hashp2", "block_lines": 16384,
+             "pallas": {"False": {"mb_s": 40.0},
+                        "True": {"error": "MosaicError: ..."}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned["use_pallas"] is False
 
 
 def test_evidence_tuning_survives_malformed_rows(tmp_path, monkeypatch, capsys):
