@@ -67,8 +67,10 @@ _EMITS_ENV = os.environ.get("LOCUST_BENCH_EMITS")
 _KEY_WIDTH_ENV = os.environ.get("LOCUST_BENCH_KEY_WIDTH")
 # "0"/"1": force the Pallas map kernel off/on, overriding both the static
 # default and any evidence-tuned flip (the escape hatch every other tuned
-# knob already has via its LOCUST_BENCH_* var).
-_PALLAS_ENV = os.environ.get("LOCUST_BENCH_PALLAS")
+# knob already has via its LOCUST_BENCH_* var).  Empty means auto (like
+# the other knobs); anything else is a loud error, not a silent force-off
+# (validated in run_bench so the one-JSON-line contract still holds).
+_PALLAS_ENV = os.environ.get("LOCUST_BENCH_PALLAS") or None
 _PER_BACKEND = {
     "tpu": {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False},
     "cpu": {"block_lines": 16384, "sort_mode": "hash1", "use_pallas": False},
@@ -245,6 +247,43 @@ def load_corpus(target_bytes: int) -> list[bytes]:
     return lines
 
 
+def bench_engine_config(block_lines: int, **overrides):
+    """The headline bench's exact EngineConfig policy, shared with the
+    sweep's A/B phases (scripts/opp_resume.py) so adopted winners were
+    measured at the configuration the bench actually runs: table_size is
+    pinned to the DEFAULT-caps resolution (auto-sized emits_per_line must
+    not shrink the accumulator, see run_bench)."""
+    sys.path.insert(0, _HERE)
+    from locust_tpu.config import EngineConfig
+
+    return EngineConfig(
+        block_lines=block_lines,
+        table_size=EngineConfig(block_lines=block_lines).resolved_table_size,
+        **overrides,
+    )
+
+
+def bench_auto_caps(lines, label: str = "[bench]") -> tuple[int, int]:
+    """Measure + log the corpus's lossless caps at the bench's ceilings
+    (the engine defaults).  One implementation for bench and sweep."""
+    sys.path.insert(0, _HERE)
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.io.loader import auto_caps
+
+    d = EngineConfig()
+    t0 = time.perf_counter()
+    kw, epl, max_tok, max_per_line = auto_caps(
+        lines, d.key_width, d.emits_per_line
+    )
+    print(
+        f"{label} corpus caps: max_token={max_tok}B max_tokens/line="
+        f"{max_per_line} -> key_width={kw} emits_per_line={epl} "
+        f"({time.perf_counter()-t0:.1f}s)",
+        file=sys.stderr,
+    )
+    return kw, epl
+
+
 def run_bench(backend: str) -> dict:
     import jax
 
@@ -268,21 +307,17 @@ def run_bench(backend: str) -> dict:
     # resolved_table_size = min(65536, block_lines*emits_per_line) and
     # truncate keys the default config keeps), so the result is always
     # byte-identical to a default-config run.
-    if _EMITS_ENV and _KEY_WIDTH_ENV:
-        auto_kw, auto_epl = 32, 20  # both pinned; skip the host pass
-    else:
-        from locust_tpu.io.loader import auto_caps
-
-        t0 = time.perf_counter()
-        auto_kw, auto_epl, max_tok, max_per_line = auto_caps(lines, 32, 20)
-        print(
-            f"[bench] corpus caps: max_token={max_tok}B max_tokens/line="
-            f"{max_per_line} -> key_width={auto_kw} emits_per_line={auto_epl} "
-            f"({time.perf_counter()-t0:.1f}s)",
-            file=sys.stderr,
+    if _PALLAS_ENV is not None and _PALLAS_ENV not in ("0", "1"):
+        raise ValueError(
+            f"LOCUST_BENCH_PALLAS must be '0' or '1', got {_PALLAS_ENV!r}"
         )
-    cfg = EngineConfig(
-        block_lines=block_lines,
+    if _EMITS_ENV and _KEY_WIDTH_ENV:
+        d = EngineConfig()
+        auto_kw, auto_epl = d.key_width, d.emits_per_line  # both pinned
+    else:
+        auto_kw, auto_epl = bench_auto_caps(lines)
+    cfg = bench_engine_config(
+        block_lines,
         sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
         emits_per_line=int(_EMITS_ENV) if _EMITS_ENV else auto_epl,
         key_width=int(_KEY_WIDTH_ENV) if _KEY_WIDTH_ENV else auto_kw,
@@ -291,7 +326,6 @@ def run_bench(backend: str) -> dict:
             if _PALLAS_ENV is not None
             else defaults.get("use_pallas", False)
         ),
-        table_size=EngineConfig(block_lines=block_lines).resolved_table_size,
     )
     eng = MapReduceEngine(cfg)
     rows = eng.rows_from_lines(lines)

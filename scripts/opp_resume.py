@@ -81,9 +81,15 @@ def phase_stage_parity() -> None:
 
 
 def _staged_rows():
-    """One host-side corpus conversion feeding phases 3 and 3.5 (identical
+    """One host-side corpus conversion feeding phases 3 - 3.7 (identical
     line_width): rows_from_lines over a 32MB corpus costs seconds of
-    tunnel-window time per call."""
+    tunnel-window time per call.
+
+    Also measures the corpus's lossless caps ONCE — the A/B phases run at
+    the same auto-sized key_width/emits_per_line the headline bench will
+    use (bench.py auto-sizes), so the winners bench.py adopts were
+    measured at the configuration it actually runs.
+    """
     import bench
 
     from locust_tpu.config import EngineConfig
@@ -91,24 +97,28 @@ def _staged_rows():
 
     lines = bench.load_corpus(int(os.environ.get("LOCUST_OPP_AB_BYTES", 32 << 20)))
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
+    kw, epl = bench.bench_auto_caps(lines, label="[opp]")
     rows = MapReduceEngine(EngineConfig(block_lines=32768)).rows_from_lines(lines)
-    return rows, corpus_bytes
+    return rows, corpus_bytes, kw, epl
 
 
-def phase_sort_mode_ab(rows_ab, corpus_bytes) -> str:
+def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
     """Engine end-to-end per sort mode at bench shapes.
 
     Returns the winning mode so phase_block_lines sweeps AT that mode —
     bench.py only adopts a (sort_mode, block_lines) pair a window
     actually measured together.
     """
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
     results = {}
     for mode in AB_SORT_MODES:
-        eng = MapReduceEngine(EngineConfig(block_lines=32768, sort_mode=mode))
+        eng = MapReduceEngine(
+            bench.bench_engine_config(32768, sort_mode=mode, **(caps or {}))
+        )
         blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
         t0 = time.perf_counter()
@@ -127,25 +137,32 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes) -> str:
         print(f"[opp] mode={mode}: {results[mode]}", file=sys.stderr)
     artifacts.record(
         "engine_sort_mode_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "modes": results},
+        {"corpus_mb": round(corpus_bytes / 1e6, 1), "caps": caps,
+         "modes": results},
     )
     return max(results, key=lambda m: results[m]["mb_s"])
 
 
-def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash") -> int:
+def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
+                      caps=None) -> int:
     """block_lines tuning at the headline-bench shape — dispatch granularity
     vs per-block sort size is the one free knob left.  Swept at
     ``sort_mode`` (the phase-3 winner) and the row records it, so the
     (sort_mode, block_lines) pair bench.py adopts was measured jointly."""
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
     results = {}
+    staged = {}  # winner's blocks are handed to phase_pallas_ab (no re-H2D)
     for bl in (16384, 32768, 65536):
-        eng = MapReduceEngine(EngineConfig(block_lines=bl, sort_mode=sort_mode))
+        eng = MapReduceEngine(
+            bench.bench_engine_config(bl, sort_mode=sort_mode, **(caps or {}))
+        )
         blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
+        staged[str(bl)] = blocks
         eng.run_blocks(blocks)  # compile + warm
         best = float("inf")
         for _ in range(3):
@@ -159,13 +176,15 @@ def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash") -> int:
     artifacts.record(
         "block_lines_ab",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
-         "blocks": results},
+         "caps": caps, "blocks": results},
     )
-    return int(max(results, key=lambda b: results[b]["mb_s"]))
+    best = max(results, key=lambda b: results[b]["mb_s"])
+    return int(best), staged[best]
 
 
 def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
-                    block_lines: int = 32768) -> None:
+                    block_lines: int = 32768, caps=None,
+                    blocks=None) -> None:
     """Engine end-to-end with the Pallas vs jnp Map tokenizer at the
     winning (sort_mode, block_lines) configuration — the joint
     measurement that can justify flipping the use_pallas default
@@ -175,17 +194,17 @@ def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
     with.  Each side is isolated so a Pallas lowering failure records an
     error instead of killing the remaining phases.
     """
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
     results = {}
-    blocks = None
     for flag in (False, True):
         try:
             eng = MapReduceEngine(
-                EngineConfig(block_lines=block_lines, sort_mode=sort_mode,
-                             use_pallas=flag)
+                bench.bench_engine_config(block_lines, sort_mode=sort_mode,
+                                          use_pallas=flag, **(caps or {}))
             )
             if blocks is None:
                 blocks = eng.prepare_blocks(rows_ab)
@@ -207,11 +226,11 @@ def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
     artifacts.record(
         "engine_pallas_ab",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
-         "block_lines": block_lines, "pallas": results},
+         "block_lines": block_lines, "caps": caps, "pallas": results},
     )
 
 
-def phase_emits_ab(rows_ab, corpus_bytes) -> None:
+def phase_emits_ab(rows_ab, corpus_bytes, key_width: int = 32) -> None:
     """emits_per_line A/B at the headline-bench shape.
 
     The reference hardcodes EMITS_PER_LINE=20 (main.cu:19); most slots are
@@ -220,7 +239,8 @@ def phase_emits_ab(rows_ab, corpus_bytes) -> None:
     the overflow counter stays 0 (identical output table) — the row
     records overflow so a cap that drops tokens is self-evident.
     """
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
@@ -231,7 +251,8 @@ def phase_emits_ab(rows_ab, corpus_bytes) -> None:
     blocks = None  # staged once: prepare_blocks doesn't depend on the cap
     for epl in (10, 12, 17, 20):
         eng = MapReduceEngine(
-            EngineConfig(block_lines=32768, emits_per_line=epl)
+            bench.bench_engine_config(32768, emits_per_line=epl,
+                                      key_width=key_width)
         )
         if blocks is None:
             blocks = eng.prepare_blocks(rows_ab)
@@ -251,7 +272,8 @@ def phase_emits_ab(rows_ab, corpus_bytes) -> None:
               file=sys.stderr)
     artifacts.record(
         "emits_per_line_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "emits": results},
+        {"corpus_mb": round(corpus_bytes / 1e6, 1), "key_width": key_width,
+         "emits": results},
     )
 
 
@@ -266,7 +288,8 @@ def phase_key_width_ab(rows_ab, corpus_bytes) -> None:
     32-byte-width run, not just the distinct count.  (hamlet max token:
     14 bytes; the Zipf generator's: 7.)
     """
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
@@ -275,7 +298,7 @@ def phase_key_width_ab(rows_ab, corpus_bytes) -> None:
     blocks = None  # staged once: line blocks don't depend on key_width
     for kw in (32, 16):
         eng = MapReduceEngine(
-            EngineConfig(block_lines=32768, key_width=kw)
+            bench.bench_engine_config(32768, key_width=kw)
         )
         if blocks is None:
             blocks = eng.prepare_blocks(rows_ab)
@@ -336,12 +359,15 @@ def phase_stream() -> None:
 def run_phases() -> None:
     """Phases 2.5 -> 4, in the order the full sweep runs them."""
     phase_stage_parity()
-    rows_ab, corpus_bytes = _staged_rows()
-    winner = phase_sort_mode_ab(rows_ab, corpus_bytes)
-    best_bl = phase_block_lines(rows_ab, corpus_bytes, sort_mode=winner)
+    rows_ab, corpus_bytes, kw, epl = _staged_rows()
+    caps = {"key_width": kw, "emits_per_line": epl}
+    winner = phase_sort_mode_ab(rows_ab, corpus_bytes, caps=caps)
+    best_bl, best_blocks = phase_block_lines(
+        rows_ab, corpus_bytes, sort_mode=winner, caps=caps
+    )
     phase_pallas_ab(rows_ab, corpus_bytes, sort_mode=winner,
-                    block_lines=best_bl)
-    phase_emits_ab(rows_ab, corpus_bytes)
+                    block_lines=best_bl, caps=caps, blocks=best_blocks)
+    phase_emits_ab(rows_ab, corpus_bytes, key_width=kw)
     phase_key_width_ab(rows_ab, corpus_bytes)
     phase_stream()
 
