@@ -88,6 +88,24 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+# Fail fast on a malformed env override — at import, before the
+# orchestrator can burn its whole TPU retry budget re-discovering the
+# same deterministic typo in every child — while still honoring the
+# one-JSON-line contract.
+if _PALLAS_ENV is not None and _PALLAS_ENV not in ("0", "1"):
+    emit(
+        {
+            "metric": "wordcount_throughput",
+            "value": 0.0,
+            "unit": "MB/s",
+            "vs_baseline": 0.0,
+            "error": f"LOCUST_BENCH_PALLAS must be '0' or '1', "
+                     f"got {_PALLAS_ENV!r}",
+        }
+    )
+    sys.exit(1)
+
+
 def error_payload(msg: str) -> dict:
     return {
         "metric": "wordcount_throughput",
@@ -134,7 +152,7 @@ def _last_tpu_bench_row() -> dict | None:
     }
 
 
-def _evidence_tuned_tpu_defaults(defaults: dict) -> dict:
+def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> dict:
     """Fold committed on-hardware A/B evidence into the TPU defaults.
 
     The tunnel flaps; a window's sweep (scripts/opp_resume.py) may have
@@ -146,11 +164,25 @@ def _evidence_tuned_tpu_defaults(defaults: dict) -> dict:
     static default.
     """
     out = dict(defaults)
+
+    def caps_match(row: dict) -> bool:
+        """Joint-measurement rule for the capacity axes: the row's
+        recorded caps (older rows predate the field = engine defaults)
+        must equal the caps this bench run assembles."""
+        if caps is None:
+            return True
+        row_caps = row.get("caps") or {"key_width": 32, "emits_per_line": 20}
+        return (
+            int(row_caps.get("key_width", 32)) == caps["key_width"]
+            and int(row_caps.get("emits_per_line", 20))
+            == caps["emits_per_line"]
+        )
+
     # Evidence must never break a run (same stance as utils/artifacts.py):
     # a malformed or stale row falls back to the static defaults.
     try:
         ab = _tpu_rows("engine_sort_mode_ab")
-        if ab:
+        if ab and caps_match(ab[-1]):
             modes = ab[-1].get("modes", {})
             if modes:
                 best = max(
@@ -173,7 +205,11 @@ def _evidence_tuned_tpu_defaults(defaults: dict) -> dict:
         if bl:
             row = bl[-1]
             blocks = row.get("blocks", {})
-            if blocks and row.get("sort_mode", "hash") == out["sort_mode"]:
+            if (
+                blocks
+                and caps_match(row)
+                and row.get("sort_mode", "hash") == out["sort_mode"]
+            ):
                 best = max(
                     blocks, key=lambda b: (blocks[b] or {}).get("mb_s", 0.0)
                 )
@@ -191,7 +227,8 @@ def _evidence_tuned_tpu_defaults(defaults: dict) -> dict:
         if pa:
             row = pa[-1]
             joint = (
-                row.get("sort_mode", "hash") == out["sort_mode"]
+                caps_match(row)
+                and row.get("sort_mode", "hash") == out["sort_mode"]
                 and int(row.get("block_lines", 32768)) == out["block_lines"]
             )
             sides = row.get("pallas", {})
@@ -294,33 +331,37 @@ def run_bench(backend: str) -> dict:
     lines = load_corpus(target)
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
     defaults = _PER_BACKEND.get(backend, _PER_BACKEND["cpu"])
-    if backend == "tpu":
-        defaults = _evidence_tuned_tpu_defaults(defaults)
-    block_lines = (
-        int(_BLOCK_LINES_ENV) if _BLOCK_LINES_ENV else defaults["block_lines"]
-    )
     # Lossless capacity auto-sizing (env overrides win).  key_width=16 on
     # hamlet: 1.72x end-to-end on CPU at an identical output table
     # (distinct=5608 both widths).  Caps never exceed the defaults AND
-    # table_size is pinned to what the DEFAULT emits_per_line would
-    # resolve (a smaller cap would otherwise shrink
+    # bench_engine_config pins table_size to what the DEFAULT
+    # emits_per_line would resolve (a smaller cap would otherwise shrink
     # resolved_table_size = min(65536, block_lines*emits_per_line) and
     # truncate keys the default config keeps), so the result is always
     # byte-identical to a default-config run.
-    if _PALLAS_ENV is not None and _PALLAS_ENV not in ("0", "1"):
-        raise ValueError(
-            f"LOCUST_BENCH_PALLAS must be '0' or '1', got {_PALLAS_ENV!r}"
-        )
     if _EMITS_ENV and _KEY_WIDTH_ENV:
         d = EngineConfig()
         auto_kw, auto_epl = d.key_width, d.emits_per_line  # both pinned
     else:
         auto_kw, auto_epl = bench_auto_caps(lines)
+    eff_kw = int(_KEY_WIDTH_ENV) if _KEY_WIDTH_ENV else auto_kw
+    eff_epl = int(_EMITS_ENV) if _EMITS_ENV else auto_epl
+    if backend == "tpu":
+        # Caps are part of the joint-measurement rule: A/B rows are only
+        # trusted if swept at the caps THIS bench run assembles (a
+        # LOCUST_BENCH_VOCAB corpus has different auto caps than the
+        # sweep's corpus and must not inherit its winners).
+        defaults = _evidence_tuned_tpu_defaults(
+            defaults, {"key_width": eff_kw, "emits_per_line": eff_epl}
+        )
+    block_lines = (
+        int(_BLOCK_LINES_ENV) if _BLOCK_LINES_ENV else defaults["block_lines"]
+    )
     cfg = bench_engine_config(
         block_lines,
         sort_mode=_SORT_MODE_ENV or defaults["sort_mode"],
-        emits_per_line=int(_EMITS_ENV) if _EMITS_ENV else auto_epl,
-        key_width=int(_KEY_WIDTH_ENV) if _KEY_WIDTH_ENV else auto_kw,
+        emits_per_line=eff_epl,
+        key_width=eff_kw,
         use_pallas=(
             _PALLAS_ENV == "1"
             if _PALLAS_ENV is not None

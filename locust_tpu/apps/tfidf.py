@@ -1,0 +1,185 @@
+"""TF-IDF scoring: one composite-key WordCount gives tf AND df.
+
+Beyond the reference's workload set (it ships WordCount only), but a
+direct composition of the framework's primitives that pressure-tests the
+key machinery's generality: the emit key is (word, doc) — the word's
+packed byte lanes plus ONE extra uint32 lane carrying the doc id — and
+the STANDARD Process/Reduce stages (ops/process_stage.sort_and_compact,
+ops/reduce_stage.segment_reduce_into) fold those composite pairs across
+blocks unchanged, because every sort mode and boundary compare is
+generic over the lane count.
+
+From the resulting {(word, doc): tf} table both remaining quantities are
+host-side folds over a table that is orders of magnitude smaller than
+the corpus: df(word) = number of pairs with that word, n_docs = distinct
+doc ids seen, and
+
+    score(word, doc) = tf * ln(n_docs / df(word))
+
+(the classic unsmoothed formulation; a df of n_docs scores 0).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.ops.map_stage import tokenize_block
+from locust_tpu.ops.process_stage import sort_and_compact
+from locust_tpu.ops.reduce_stage import segment_reduce_into
+
+logger = logging.getLogger("locust_tpu")
+
+
+def _fold_tf_block(
+    acc: KVBatch,
+    lines: jax.Array,
+    doc_ids: jax.Array,
+    cfg: EngineConfig,
+    tsize: int,
+):
+    """Merge one block's (word, doc) -> 1 emits into the running tf table.
+
+    Identical shape to the WordCount engine's fold (engine.py fold_block)
+    — concat with the accumulator, ONE sort, segment-sum into capacity —
+    on a batch whose key has ``cfg.key_lanes + 1`` lanes: the word plus a
+    big-endian doc-id lane (lane order IS byte order, core/packing, so
+    the host can split the decoded key back into word and doc id).
+    """
+    res = tokenize_block(lines, cfg)
+    flat_keys = res.keys.reshape(-1, cfg.key_width)
+    flat_valid = res.valid.reshape(-1)
+    word_lanes = KVBatch.from_bytes(
+        flat_keys, jnp.ones(flat_keys.shape[0], jnp.int32), flat_valid
+    ).key_lanes
+    docs = jnp.repeat(doc_ids.astype(jnp.uint32), cfg.emits_per_line)
+    comp = KVBatch(
+        key_lanes=jnp.concatenate([word_lanes, docs[:, None]], axis=-1),
+        values=jnp.ones(flat_keys.shape[0], jnp.int32),
+        valid=flat_valid,
+    )
+    merged, distinct = segment_reduce_into(
+        sort_and_compact(KVBatch.concat(acc, comp), cfg.sort_mode),
+        tsize,
+        "sum",
+    )
+    return merged, distinct, res.overflow
+
+
+_fold_tf_jit = jax.jit(_fold_tf_block, static_argnames=("cfg", "tsize"))
+
+
+def term_doc_counts(
+    lines: list[bytes] | np.ndarray,
+    doc_ids: np.ndarray,
+    cfg: EngineConfig | None = None,
+    pairs_capacity: int | None = None,
+    allow_overflow: bool = False,
+) -> dict[tuple[bytes, int], int]:
+    """Host API: lines + per-line doc ids -> {(word, doc id): count}.
+
+    Streams fixed-shape blocks like the WordCount engine.  Exceeding
+    ``pairs_capacity`` (default 2x emits_per_block) raises, and so does
+    dropping tokens past the per-line emit cap (unless
+    ``allow_overflow=True`` downgrades that to a warning) — either loss
+    makes tf-idf scores silently wrong, and a plain dict return has no
+    other channel to signal it.
+    """
+    cfg = cfg or EngineConfig()
+    cap = pairs_capacity or 2 * cfg.emits_per_block
+    if not isinstance(lines, np.ndarray):
+        rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
+    else:
+        rows = lines
+    ids = np.asarray(doc_ids, np.int32)
+    if rows.shape[0] != ids.shape[0]:
+        raise ValueError(f"{rows.shape[0]} lines but {ids.shape[0]} doc ids")
+    if ids.size and ids.min() < 0:
+        # The doc id rides a uint32 key lane; -1 would wrap to 2**32-1 and
+        # come back as a different key than the caller passed in.
+        raise ValueError(f"doc ids must be >= 0, got min {int(ids.min())}")
+
+    bl = cfg.block_lines
+    nblocks = max(1, -(-rows.shape[0] // bl))
+    pad = nblocks * bl - rows.shape[0]
+    rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
+    ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+
+    acc = KVBatch.empty(cap, cfg.key_lanes + 1)
+    distinct_dev = jnp.int32(0)  # device scalars: no per-block host sync
+    overflow_dev = jnp.int32(0)
+    for b in range(nblocks):
+        sl = slice(b * bl, (b + 1) * bl)
+        acc, blk_distinct, blk_ovf = _fold_tf_jit(
+            acc, jnp.asarray(rows[sl]), jnp.asarray(ids[sl]), cfg, cap
+        )
+        distinct_dev = jnp.maximum(distinct_dev, blk_distinct)
+        overflow_dev = overflow_dev + blk_ovf
+    if int(overflow_dev):
+        msg = (
+            f"tf-idf dropped {int(overflow_dev)} tokens beyond the "
+            f"{cfg.emits_per_line}-per-line cap; their counts are MISSING "
+            "— raise emits_per_line"
+        )
+        if not allow_overflow:
+            raise ValueError(msg)
+        logger.warning(msg)
+    if int(distinct_dev) > cap:
+        raise ValueError(
+            f"distinct (word, doc) pairs ({int(distinct_dev)}) exceed "
+            f"pairs_capacity ({cap}); pass a larger pairs_capacity"
+        )
+
+    # Host decode, splitting the composite key NUMERICALLY (KVBatch
+    # .to_host_pairs would NUL-strip the key bytes, eating a doc-id lane
+    # whose low bytes are zero): word lanes -> bytes, doc lane -> int.
+    lanes, values, valid = jax.device_get((acc.key_lanes, acc.values, acc.valid))
+    live = np.asarray(valid)
+    lanes = np.asarray(lanes)[live]
+    counts = np.asarray(values)[live]
+    n_live = lanes.shape[0]
+    if n_live == 0:
+        return {}
+    word_bytes = (
+        lanes[:, :-1].astype(">u4").view(np.uint8).reshape(n_live, -1)
+    )
+    words = bytes_ops.rows_to_strings(word_bytes)
+    docs = lanes[:, -1].astype(np.int64)
+    out: dict[tuple[bytes, int], int] = {}
+    for word, doc, count in zip(words, docs, counts):
+        pair = (word, int(doc))
+        # A full-hash collision can split a pair into two table rows
+        # (same ~2^-64 story as the engine, engine.finalize_host_pairs).
+        out[pair] = out.get(pair, 0) + int(count)
+    return out
+
+
+def build_tfidf(
+    lines: list[bytes] | np.ndarray,
+    doc_ids: np.ndarray,
+    cfg: EngineConfig | None = None,
+    pairs_capacity: int | None = None,
+    allow_overflow: bool = False,
+) -> dict[tuple[bytes, int], float]:
+    """{(word, doc id): tf-idf score} over line-sharded documents.
+
+    ``score = tf * ln(n_docs / df)`` — tf from the device pair table,
+    df and n_docs as host folds over that same (already tiny) table.
+    """
+    ids = np.asarray(doc_ids, np.int32)
+    tf = term_doc_counts(lines, ids, cfg, pairs_capacity, allow_overflow)
+    n_docs = len(set(int(d) for d in ids)) or 1
+    df: dict[bytes, int] = {}
+    for word, _ in tf:
+        df[word] = df.get(word, 0) + 1
+    return {
+        (word, doc): count * math.log(n_docs / df[word])
+        for (word, doc), count in tf.items()
+    }

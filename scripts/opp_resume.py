@@ -144,25 +144,29 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
 
 
 def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
-                      caps=None) -> int:
+                      caps=None):
     """block_lines tuning at the headline-bench shape — dispatch granularity
     vs per-block sort size is the one free knob left.  Swept at
     ``sort_mode`` (the phase-3 winner) and the row records it, so the
-    (sort_mode, block_lines) pair bench.py adopts was measured jointly."""
+    (sort_mode, block_lines) pair bench.py adopts was measured jointly.
+
+    Returns ``(winning block_lines, its staged device blocks)`` so
+    phase_pallas_ab skips one full-corpus H2D; only the best-so-far
+    staging is kept alive (losers are dropped as soon as they're beaten,
+    bounding peak HBM at ~2 stagings instead of all three)."""
     import bench
 
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
     results = {}
-    staged = {}  # winner's blocks are handed to phase_pallas_ab (no re-H2D)
+    best_key, best_blocks = None, None
     for bl in (16384, 32768, 65536):
         eng = MapReduceEngine(
             bench.bench_engine_config(bl, sort_mode=sort_mode, **(caps or {}))
         )
         blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
-        staged[str(bl)] = blocks
         eng.run_blocks(blocks)  # compile + warm
         best = float("inf")
         for _ in range(3):
@@ -173,13 +177,19 @@ def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
             "best_s": round(best, 4),
         }
         print(f"[opp] block_lines={bl}: {results[str(bl)]}", file=sys.stderr)
+        if (
+            best_key is None
+            or results[str(bl)]["mb_s"] > results[best_key]["mb_s"]
+        ):
+            best_key, best_blocks = str(bl), blocks
+        else:
+            del blocks  # loser's staging: free its HBM before the next
     artifacts.record(
         "block_lines_ab",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
          "caps": caps, "blocks": results},
     )
-    best = max(results, key=lambda b: results[b]["mb_s"])
-    return int(best), staged[best]
+    return int(best_key), best_blocks
 
 
 def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",

@@ -200,6 +200,44 @@ def test_evidence_tuned_tpu_defaults(tmp_path, monkeypatch, capsys):
     assert tuned["use_pallas"] is False
 
 
+def test_evidence_tuning_caps_rule(tmp_path, monkeypatch, capsys):
+    """A/B rows are trusted only at matching caps: a row swept at the
+    sweep corpus's caps must not steer a bench assembling different ones
+    (e.g. a LOCUST_BENCH_VOCAB corpus)."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "caps": {"key_width": 16, "emits_per_line": 17},
+             "modes": {"hash": {"mb_s": 30.0}, "hashp": {"mb_s": 44.0}}}
+        ) + "\n")
+    # Different caps -> not adopted.
+    tuned = bench._evidence_tuned_tpu_defaults(
+        static, {"key_width": 8, "emits_per_line": 10}
+    )
+    assert tuned == static
+    # Matching caps -> adopted.
+    tuned = bench._evidence_tuned_tpu_defaults(
+        static, {"key_width": 16, "emits_per_line": 17}
+    )
+    assert tuned["sort_mode"] == "hashp"
+    # A pre-caps row (no field) counts as engine defaults 32/20.
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hash": {"mb_s": 30.0}, "hash1": {"mb_s": 44.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(
+        static, {"key_width": 32, "emits_per_line": 20}
+    )
+    assert tuned["sort_mode"] == "hash1"
+    tuned = bench._evidence_tuned_tpu_defaults(
+        static, {"key_width": 16, "emits_per_line": 17}
+    )
+    assert tuned == static
+
+
 def test_evidence_tuning_survives_malformed_rows(tmp_path, monkeypatch, capsys):
     """Evidence must never break a run: a null-mode row (exactly what
     artifacts.record's exception fallback can append) or an unknown sort
