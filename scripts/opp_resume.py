@@ -335,29 +335,57 @@ def phase_key_width_ab(rows_ab, corpus_bytes) -> None:
 
 
 def phase_stream() -> None:
-    """Optional ($LOCUST_OPP_STREAM_MB) big streaming corpus in bounded RSS."""
+    """Optional ($LOCUST_OPP_STREAM_MB) big streaming corpus in bounded RSS.
+
+    Caps are auto-sized with a bounded-memory measuring pass (the CLI's
+    ``--stream --auto-caps`` machinery): the Zipf corpus's 7-byte tokens
+    at <=10/line shrink the per-fold sort payload ~4x vs the default
+    32-byte key slots, all host-verified lossless.
+    """
     stream_mb = int(os.environ.get("LOCUST_OPP_STREAM_MB", 0))
     if not stream_mb:
         return
-    from locust_tpu.config import EngineConfig
+    import bench
+
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.io.corpus import write_corpus
-    from locust_tpu.io.loader import StreamingCorpus
+    from locust_tpu.io.loader import StreamingCorpus, measure_caps_rows, size_caps
     from locust_tpu.utils import artifacts
+
+    from locust_tpu.config import EngineConfig
 
     path = f"/tmp/opp_stream_{stream_mb}.txt"
     if not os.path.exists(path):
         write_corpus(path, stream_mb * 1_000_000, n_vocab=50_000)
     size = os.path.getsize(path)
-    eng = MapReduceEngine(EngineConfig(block_lines=32768))
+    d = EngineConfig()  # ceilings = the engine defaults, like every
+    t0 = time.perf_counter()  # other auto-caps site
+    measure_stream = StreamingCorpus(path, d.line_width, 32768)
+    fp = measure_stream.fingerprint()
+    max_tok, max_per_line = measure_caps_rows(measure_stream)
+    kw, epl = size_caps(max_tok, max_per_line, d.key_width, d.emits_per_line)
+    print(f"[opp] stream caps: max_token={max_tok}B max_tokens/line="
+          f"{max_per_line} -> key_width={kw} emits_per_line={epl} "
+          f"({time.perf_counter()-t0:.1f}s measure pass)", file=sys.stderr)
+    eng = MapReduceEngine(
+        bench.bench_engine_config(32768, key_width=kw, emits_per_line=epl)
+    )
+    run_stream_src = StreamingCorpus(path, d.line_width, 32768)
+    if run_stream_src.fingerprint() != fp:
+        # Same staleness rule as cli.py's --auto-caps: a corpus mutated
+        # between the passes would make the measured caps lossy.
+        print("[opp] stream: corpus changed between measure and run; "
+              "skipping phase", file=sys.stderr)
+        return
     t0 = time.perf_counter()
-    res = eng.run_stream(StreamingCorpus(path, 128, 32768))
+    res = eng.run_stream(run_stream_src)
     wall = time.perf_counter() - t0
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     row = {
         "corpus_mb": round(size / 1e6, 1),
         "wall_s": round(wall, 1),
         "mb_s": round(size / 1e6 / wall, 2),
+        "caps": {"key_width": kw, "emits_per_line": epl},
         "distinct": res.num_segments,
         "truncated": res.truncated,
         "peak_rss_mb": round(rss_mb, 0),
