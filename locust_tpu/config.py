@@ -78,13 +78,18 @@ class EngineConfig:
     # 3 sort operands + one index payload + gather, ~2x faster per sort and
     # ~6x faster to compile than full-key sort; equal keys still group
     # adjacently (exact-key segment boundaries downstream), device order is
-    # hash order (host output re-sorts).  "hash1": ONE 32-bit sort operand
-    # (31 hash bits + validity bit) — cheaper still; collisions only
-    # duplicate a table row, re-merged downstream (process_stage._folded_key).
+    # hash order (host output re-sorts).  "hashp": same 3 hash keys but the
+    # row rides as sort PAYLOAD operands instead of a post-sort gather —
+    # 19% faster on TPU v5e at 720k rows (the gather's random HBM reads
+    # cost more than payload carriage).  "hashp2": payload carriage with
+    # only 2 key operands (validity folded into a 31-bit primary hash, h2
+    # tiebreak).  "hash1": ONE 32-bit sort operand (31 hash bits +
+    # validity bit) + gather — the CPU winner; collisions only duplicate a
+    # table row, re-merged downstream (process_stage._folded_key).
     # "radix": same folded key sorted by O(n) LSD radix passes instead of
-    # the comparison network (ops/radix_sort.py).  "lex": sort full
-    # big-endian key lanes — exact lexicographic device order, the
-    # reference's KIVComparator semantics (KeyValue.h:20-33).
+    # the comparison network (ops/radix_sort.py; loses 2.5-3x on TPU).
+    # "lex": sort full big-endian key lanes — exact lexicographic device
+    # order, the reference's KIVComparator semantics (KeyValue.h:20-33).
     # Variant timings: scripts/bench_sort_variants.py -> artifacts/.
     sort_mode: str = "hash"
 
