@@ -362,7 +362,9 @@ class DistributedInvertedIndex:
         self.lines_per_round)`` with a doc-id generator.  Only one chunk
         plus the sharded pair table are ever resident.
         """
-        return self._run_rounds(iter(blocks), stats_sync_every)
+        from locust_tpu.io.loader import prefetch_blocks
+
+        return self._run_rounds(prefetch_blocks(blocks), stats_sync_every)
 
     def _run_rounds(self, chunk_iter, stats_sync_every: int):
         from jax.sharding import PartitionSpec as P

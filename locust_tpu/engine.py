@@ -316,7 +316,9 @@ class MapReduceEngine:
         exactly as in ``run_checkpointed``; a resume re-READS but does not
         re-process already-folded blocks.
         """
+        from locust_tpu.io.loader import prefetch_blocks
         from locust_tpu.parallel.shuffle import normalize_round_chunk
+        blocks = prefetch_blocks(blocks)  # overlap host reads with folds
         bl, w = self.cfg.block_lines, self.cfg.line_width
         acc = KVBatch.empty(self._table_size, self.cfg.key_lanes)
         overflow = jnp.int32(0)

@@ -140,6 +140,74 @@ def measure_caps_rows(row_blocks) -> tuple[int, int]:
     return max_tok, max_per_line
 
 
+class _PrefetchError:
+    """Wraps an exception crossing the reader thread (a private type no
+    legitimate block iterator yields, so the isinstance check in
+    ``prefetch_blocks`` cannot misfire on real items)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch_blocks(blocks, depth: int = 2):
+    """Iterate ``blocks`` with a daemon reader thread ``depth`` items ahead.
+
+    Streaming folds alternate host file reads with device dispatches; the
+    reader thread overlaps the next window's read+pad with the current
+    fold's device time.  Semantically transparent: same items, same
+    order, exceptions re-raised at the consuming ``next()``.  Memory grows
+    by at most ``depth`` staged blocks.
+
+    Abandoning the generator early (consumer raised mid-loop, e.g. a
+    shuffle-overflow RuntimeError) stops the reader promptly: its puts
+    poll a stop event, and the generator's ``finally`` sets it and drains
+    the queue — no thread, source iterator, or staged blocks outlive the
+    consumer (a leak per retry would accumulate in bench's TPU retry
+    loop).
+    """
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    end = object()
+    stop = threading.Event()
+
+    def put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def reader():
+        try:
+            for b in blocks:
+                if not put_or_stop(b):
+                    return
+            put_or_stop(end)
+        except BaseException as e:  # noqa: BLE001 - relayed to consumer
+            put_or_stop(_PrefetchError(e))
+
+    threading.Thread(target=reader, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 def count_lines(path: str) -> int:
     """Streaming line count (O(1) memory; multi-GB corpora are fine).
 

@@ -226,7 +226,9 @@ class HierarchicalMapReduce:
         Checkpoint/resume is not offered here yet; use the flat
         ``DistributedMapReduce`` for resumable runs.
         """
-        return self._run_rounds(iter(blocks), stats_sync_every)
+        from locust_tpu.io.loader import prefetch_blocks
+
+        return self._run_rounds(prefetch_blocks(blocks), stats_sync_every)
 
     def _run_rounds(self, chunk_iter, stats_sync_every: int):
         from locust_tpu.parallel.mesh import shard_rows

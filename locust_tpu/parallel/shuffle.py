@@ -564,13 +564,15 @@ class DistributedMapReduce:
         enable checkpoint/resume (resume re-reads but does not re-process
         already-folded rounds).
         """
+        from locust_tpu.io.loader import prefetch_blocks
+
         if checkpoint_dir is not None and fingerprint is None:
             raise ValueError(
                 "run_stream needs an explicit corpus fingerprint to "
                 "checkpoint (e.g. StreamingCorpus.fingerprint())"
             )
         return self._run_rounds(
-            iter(blocks),
+            prefetch_blocks(blocks),  # overlap host reads with rounds
             fingerprint=fingerprint,
             shard_fn=shard_fn,
             checkpoint_dir=checkpoint_dir,
