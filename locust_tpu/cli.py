@@ -391,14 +391,6 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
         with timer.span("load"):
             kw = {}
             if args.checkpoint_dir:
-                if args.slices:
-                    print(
-                        "mapreduce: error: --slices does not support "
-                        "--checkpoint-dir yet (use the flat --mesh engine "
-                        "for resumable runs)",
-                        file=sys.stderr,
-                    )
-                    return 2
                 kw = dict(
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every,

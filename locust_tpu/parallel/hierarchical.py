@@ -206,34 +206,115 @@ class HierarchicalMapReduce:
     def lines_per_round(self) -> int:
         return self.n_dev * self.cfg.block_lines
 
-    def run(self, rows, stats_sync_every: int = 16):
+    def _identity(self) -> dict:
+        """Engine/pipeline/mesh identity bound into every checkpoint
+        fingerprint (both run and run_stream), so a hierarchical snapshot
+        can never be resumed by a different engine/mesh/pipeline over the
+        same corpus (shuffle.DistributedMapReduce._identity mirror)."""
+        norm_map_fn, _ = normalize_combine(self.map_fn, self.combine)
+        return dict(
+            engine="hierarchical",
+            cfg=repr(self.cfg),
+            combine=self.combine,
+            map_fn=getattr(norm_map_fn, "__name__", str(norm_map_fn)),
+            mesh=(
+                f"{self.n_slices}x{self.slice_axis},"
+                f"{self.devs_per_slice}x{self.data_axis}"
+            ),
+            bin_capacity=self.bin_capacity,
+            shard_capacity=self.shard_capacity,
+        )
+
+    def _fingerprint(self, rows) -> str:
+        """Identity of a (corpus, pipeline, mesh) combination for resume."""
+        from locust_tpu.io.serde import fingerprint_corpus
+
+        return fingerprint_corpus(rows, **self._identity())
+
+    def run(
+        self,
+        rows,
+        stats_sync_every: int = 16,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ):
         """Run a host ``[n, width]`` row array; returns ``DistributedResult``.
 
         ``truncated`` reflects both the per-slice partial tables and the
         FINAL combined table (worst shard's distinct keys vs capacity);
         ``drain_rounds`` reports the worst slice's full-run total (the
         wall-clock-relevant number — slices drain independently).
+
+        With ``checkpoint_dir``, the same per-process atomic-npz protocol
+        as the flat engine: every ``checkpoint_every`` completed rounds
+        the sharded accumulator + backlog + counters snapshot; a re-run
+        with the matching fingerprint resumes after the last completed
+        round.
         """
         lpr = self.lines_per_round
         nrounds = max(1, -(-rows.shape[0] // lpr))
         chunks = (rows[r * lpr : (r + 1) * lpr] for r in range(nrounds))
-        return self._run_rounds(chunks, stats_sync_every)
+        return self._run_rounds(
+            chunks,
+            stats_sync_every,
+            fingerprint=(
+                self._fingerprint(rows) if checkpoint_dir is not None else None
+            ),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
 
-    def run_stream(self, blocks, stats_sync_every: int = 16):
+    def run_stream(
+        self,
+        blocks,
+        stats_sync_every: int = 16,
+        fingerprint: str | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ):
         """Like ``run`` over an ITERABLE of ``[<=lines_per_round, width]``
         host row blocks — bounded-memory ingest (pair with
         ``io.loader.StreamingCorpus(path, width, self.lines_per_round)``).
-        Checkpoint/resume is not offered here yet; use the flat
-        ``DistributedMapReduce`` for resumable runs.
+        Pass the stream's ``fingerprint()`` to enable checkpoint/resume
+        (resume re-reads but does not re-process already-folded rounds).
         """
         from locust_tpu.io.loader import prefetch_blocks
 
-        return self._run_rounds(prefetch_blocks(blocks), stats_sync_every)
+        if checkpoint_dir is not None and fingerprint is None:
+            raise ValueError(
+                "run_stream needs an explicit corpus fingerprint to "
+                "checkpoint (e.g. StreamingCorpus.fingerprint())"
+            )
+        if fingerprint is not None:
+            # Bind engine identity: the caller's fingerprint covers only
+            # the corpus (file identity), same pattern as engine.run_stream.
+            fingerprint = f"{fingerprint}:{self._identity()}"
+        return self._run_rounds(
+            prefetch_blocks(blocks),
+            stats_sync_every,
+            fingerprint=fingerprint,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
 
-    def _run_rounds(self, chunk_iter, stats_sync_every: int):
+    def _run_rounds(
+        self,
+        chunk_iter,
+        stats_sync_every: int,
+        fingerprint: str | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ):
         from locust_tpu.parallel.mesh import shard_rows
-        from locust_tpu.parallel.shuffle import DistributedResult
+        from locust_tpu.parallel.shuffle import (
+            DistributedResult,
+            ShardedCheckpoint,
+        )
 
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
         cfg = self.cfg
         lpr = self.lines_per_round
         width = cfg.line_width
@@ -254,6 +335,29 @@ class HierarchicalMapReduce:
         # the reported number is the worst slice's full-run total.
         drains_by_slice = np.zeros(self.n_slices, np.int64)
         truncated = False
+        start_round = 0
+
+        ckpt = None
+        if checkpoint_dir is not None:
+            ckpt = ShardedCheckpoint(checkpoint_dir, fingerprint, sharding)
+            restored = ckpt.load()
+            if restored is not None:
+                start_round, extras, acc, leftover = restored
+                emit_ovf = int(extras["emit_ovf"])
+                shuf_ovf = int(extras["shuf_ovf"])
+                drains_by_slice[:] = extras["drains_by_slice"]
+                truncated = bool(extras["truncated"])
+
+        def snapshot(next_round: int) -> None:
+            ckpt.snapshot(
+                next_round,
+                acc,
+                leftover,
+                emit_ovf=np.int64(emit_ovf),
+                shuf_ovf=np.int64(shuf_ovf),
+                drains_by_slice=drains_by_slice,
+                truncated=np.bool_(truncated),
+            )
 
         def on_sync(st) -> None:
             """Fold the [n_slices, 6] per-slice stats stack into host
@@ -281,12 +385,22 @@ class HierarchicalMapReduce:
             self._stats_merge, on_sync, stats_sync_every,
             fetch_fn=self._fetch_stats,
         )
-        for chunk in chunk_iter:
+        last_snapshot = nrounds = start_round
+        for r, chunk in enumerate(chunk_iter):
+            if r < start_round:  # resume: skip already-folded rounds
+                continue
+            nrounds = r + 1
             chunk = normalize_round_chunk(chunk, lpr, width)
             sharded = shard_rows(chunk, self.mesh, (self.slice_axis, self.data_axis))
             acc, leftover, stats = self._step(sharded, acc, leftover)
             round_stats.push(stats)
+            if ckpt is not None and (r + 1) % checkpoint_every == 0:
+                round_stats.flush()  # snapshots must persist correct counters
+                snapshot(r + 1)
+                last_snapshot = r + 1
         round_stats.flush()
+        if ckpt is not None and last_snapshot != nrounds:
+            snapshot(nrounds)
         drains_used = int(drains_by_slice.max())
 
         # The one DCN hop: cross-slice merge of the bounded tables.

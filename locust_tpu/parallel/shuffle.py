@@ -32,6 +32,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from locust_tpu.config import EngineConfig
@@ -88,6 +89,98 @@ def normalize_round_chunk(chunk, lpr: int, width: int):
         padded[: chunk.shape[0], : chunk.shape[1]] = chunk
         chunk = padded
     return chunk
+
+
+class ShardedCheckpoint:
+    """Per-process atomic-npz snapshot protocol for sharded engine state.
+
+    The ONE implementation behind both mesh engines' checkpoint/resume
+    (the RoundStats principle: a protocol fix cannot silently diverge
+    between them).  A snapshot holds the gathered accumulator + shuffle
+    backlog, the round cursor, the run fingerprint, and whatever extra
+    host counters the engine passes — restored as-is, so each engine
+    keeps its own counter schema while sharing load/replace/atomicity.
+    """
+
+    _RESERVED = (
+        "fingerprint", "next_round",
+        "acc_key_lanes", "acc_values", "acc_valid",
+        "left_key_lanes", "left_values", "left_valid",
+    )
+
+    def __init__(self, checkpoint_dir: str, fingerprint: str, sharding):
+        import os
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.path = os.path.join(
+            checkpoint_dir, f"state.p{jax.process_index()}.npz"
+        )
+        self.fingerprint = fingerprint
+        self.sharding = sharding
+
+    def load(self):
+        """Returns ``(start_round, extras, acc, leftover)`` from a
+        matching snapshot, or None (missing / different-run)."""
+        import os
+
+        if not os.path.exists(self.path):
+            return None
+        with np.load(self.path) as z:
+            if str(z["fingerprint"]) != self.fingerprint:
+                logger.warning(
+                    "checkpoint at %s belongs to a different run; "
+                    "starting fresh",
+                    self.path,
+                )
+                return None
+            acc = _scatter_batch_from_host(
+                KVBatch(
+                    key_lanes=z["acc_key_lanes"],
+                    values=z["acc_values"],
+                    valid=z["acc_valid"],
+                ),
+                self.sharding,
+            )
+            leftover = _scatter_batch_from_host(
+                KVBatch(
+                    key_lanes=z["left_key_lanes"],
+                    values=z["left_values"],
+                    valid=z["left_valid"],
+                ),
+                self.sharding,
+            )
+            extras = {
+                k: z[k] for k in z.files if k not in self._RESERVED
+            }
+            start_round = int(z["next_round"])
+        logger.info(
+            "resuming from checkpoint at round %d (%s)",
+            start_round,
+            self.path,
+        )
+        return start_round, extras, acc, leftover
+
+    def snapshot(self, next_round: int, acc, leftover, **extras) -> None:
+        """One atomically-replaced npz: table, backlog, cursor and
+        counters can never tear apart."""
+        import os
+
+        acc_h = _gather_batch_host(acc)
+        left_h = _gather_batch_host(leftover)
+        tmp = self.path + ".tmp.npz"
+        np.savez_compressed(
+            tmp,
+            acc_key_lanes=acc_h.key_lanes,
+            acc_values=acc_h.values,
+            acc_valid=acc_h.valid,
+            left_key_lanes=left_h.key_lanes,
+            left_values=left_h.values,
+            left_valid=left_h.valid,
+            next_round=np.int64(next_round),
+            fingerprint=np.str_(self.fingerprint),
+            **extras,
+        )
+        os.replace(tmp, self.path)
 
 
 class RoundStats:
@@ -484,12 +577,14 @@ class DistributedMapReduce:
             self.n_dev * self.leftover_capacity, self.cfg.key_lanes
         )
 
-    def _fingerprint(self, rows) -> str:
-        """Identity of a (corpus, pipeline, mesh) combination for resume."""
-        from locust_tpu.io.serde import fingerprint_corpus
-
-        return fingerprint_corpus(
-            rows,
+    def _identity(self) -> dict:
+        """Engine/pipeline/mesh identity bound into every checkpoint
+        fingerprint — both the corpus-digest path (``run``) and the
+        caller-supplied stream fingerprint (``run_stream``), so a flat
+        snapshot can never be resumed by a different engine, mesh, or
+        pipeline over the same corpus (their npz schemas differ)."""
+        return dict(
+            engine="flat",
             cfg=repr(self.cfg),
             combine=self.combine,
             # Without the map_fn identity, a resume after changing map_fn
@@ -501,6 +596,12 @@ class DistributedMapReduce:
             shard_capacity=self.shard_capacity,
             on_overflow=self.on_overflow,
         )
+
+    def _fingerprint(self, rows) -> str:
+        """Identity of a (corpus, pipeline, mesh) combination for resume."""
+        from locust_tpu.io.serde import fingerprint_corpus
+
+        return fingerprint_corpus(rows, **self._identity())
 
     def run(
         self,
@@ -571,6 +672,10 @@ class DistributedMapReduce:
                 "run_stream needs an explicit corpus fingerprint to "
                 "checkpoint (e.g. StreamingCorpus.fingerprint())"
             )
+        if fingerprint is not None:
+            # Bind engine identity: the caller's fingerprint covers only
+            # the corpus (file identity), same pattern as engine.run_stream.
+            fingerprint = f"{fingerprint}:{self._identity()}"
         return self._run_rounds(
             prefetch_blocks(blocks),  # overlap host reads with rounds
             fingerprint=fingerprint,
@@ -610,70 +715,29 @@ class DistributedMapReduce:
         truncated = False
         start_round = 0
 
-        state_path = None
+        ckpt = None
         if checkpoint_dir is not None:
-            os.makedirs(checkpoint_dir, exist_ok=True)
-            state_path = os.path.join(
-                checkpoint_dir, f"state.p{jax.process_index()}.npz"
-            )
-            if os.path.exists(state_path):
-                with np.load(state_path) as z:
-                    if str(z["fingerprint"]) == fingerprint:
-                        start_round = int(z["next_round"])
-                        emit_ovf = int(z["emit_ovf"])
-                        shuf_ovf = int(z["shuf_ovf"])
-                        distinct = int(z["distinct"])
-                        drains_used = int(z["drains_used"])
-                        truncated = bool(z["truncated"])
-                        acc = _scatter_batch_from_host(
-                            KVBatch(
-                                key_lanes=z["acc_key_lanes"],
-                                values=z["acc_values"],
-                                valid=z["acc_valid"],
-                            ),
-                            sharding,
-                        )
-                        leftover = _scatter_batch_from_host(
-                            KVBatch(
-                                key_lanes=z["left_key_lanes"],
-                                values=z["left_values"],
-                                valid=z["left_valid"],
-                            ),
-                            sharding,
-                        )
-                        logger.info(
-                            "resuming distributed run at round %d (%s)",
-                            start_round,
-                            checkpoint_dir,
-                        )
-                    else:
-                        logger.warning(
-                            "checkpoint at %s belongs to a different run; "
-                            "starting fresh",
-                            checkpoint_dir,
-                        )
+            ckpt = ShardedCheckpoint(checkpoint_dir, fingerprint, sharding)
+            restored = ckpt.load()
+            if restored is not None:
+                start_round, extras, acc, leftover = restored
+                emit_ovf = int(extras["emit_ovf"])
+                shuf_ovf = int(extras["shuf_ovf"])
+                distinct = int(extras["distinct"])
+                drains_used = int(extras["drains_used"])
+                truncated = bool(extras["truncated"])
 
         def snapshot(next_round: int) -> None:
-            acc_h = _gather_batch_host(acc)
-            left_h = _gather_batch_host(leftover)
-            tmp = state_path + ".tmp.npz"
-            np.savez_compressed(
-                tmp,
-                acc_key_lanes=acc_h.key_lanes,
-                acc_values=acc_h.values,
-                acc_valid=acc_h.valid,
-                left_key_lanes=left_h.key_lanes,
-                left_values=left_h.values,
-                left_valid=left_h.valid,
-                next_round=np.int64(next_round),
+            ckpt.snapshot(
+                next_round,
+                acc,
+                leftover,
                 emit_ovf=np.int64(emit_ovf),
                 shuf_ovf=np.int64(shuf_ovf),
                 distinct=np.int64(distinct),
                 drains_used=np.int64(drains_used),
                 truncated=np.bool_(truncated),
-                fingerprint=np.str_(fingerprint),
             )
-            os.replace(tmp, state_path)
 
         # Device-side stats accumulator: rounds dispatch back-to-back and
         # the host folds the replicated stats vector in only at sync points.
@@ -715,12 +779,12 @@ class DistributedMapReduce:
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
             acc, leftover, stats = self._step(sharded, acc, leftover)
             round_stats.push(stats)
-            if state_path is not None and (r + 1) % checkpoint_every == 0:
+            if ckpt is not None and (r + 1) % checkpoint_every == 0:
                 round_stats.flush()  # snapshots must persist correct counters
                 snapshot(r + 1)
                 last_snapshot = r + 1
         round_stats.flush()
-        if state_path is not None and last_snapshot != nrounds:
+        if ckpt is not None and last_snapshot != nrounds:
             snapshot(nrounds)
         if truncated:
             logger.warning(

@@ -155,3 +155,117 @@ def test_hierarchical_run_stream_matches_run():
         rows[i : i + lpr] for i in range(0, rows.shape[0], lpr)
     ).to_host_pairs()
     assert got == want
+
+
+def test_hierarchical_checkpoint_resume(tmp_path):
+    """Crash mid-corpus on the [2,4] mesh; a re-run resumes after the
+    last completed round and matches exactly (the flat engine's protocol,
+    test_distributed.test_distributed_checkpoint_resume)."""
+    cfg = _cfg(block_lines=2)  # 16 lines/round -> several rounds
+    lines = [b"alpha beta", b"beta gamma", b"alpha delta epsilon"] * 20
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    want = dict(
+        HierarchicalMapReduce(make_mesh_2d(2, 4), cfg).run(rows).to_host_pairs()
+    )
+    assert want == dict(py_wordcount(lines, cfg.emits_per_line, cfg.key_width))
+
+    ckpt = str(tmp_path / "hckpt")
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    real_step = h._step
+    calls = {"n": 0}
+
+    def dying_step(lines_, acc, leftover):
+        if calls["n"] == 2:
+            raise RuntimeError("simulated crash")
+        calls["n"] += 1
+        return real_step(lines_, acc, leftover)
+
+    h._step = dying_step
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        h.run(rows, checkpoint_dir=ckpt)
+    h._step = real_step
+
+    res = h.run(rows, checkpoint_dir=ckpt)
+    assert dict(res.to_host_pairs()) == want
+    # Resume skipped the completed rounds: a fully-checkpointed third run
+    # steps zero times.
+    calls["n"] = 2
+    h._step = dying_step
+    res3 = h.run(rows, checkpoint_dir=ckpt)
+    assert dict(res3.to_host_pairs()) == want
+
+
+def test_hierarchical_checkpoint_fingerprint_content(tmp_path):
+    """Same shape, different corpus -> fresh start, correct counts."""
+    cfg = _cfg(block_lines=2)
+    ckpt = str(tmp_path / "hckpt")
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    lines_a = [b"aaa bbb"] * 32
+    h.run(bytes_ops.strings_to_rows(lines_a, cfg.line_width), checkpoint_dir=ckpt)
+    lines_b = [b"ccc ddd"] * 32
+    res = h.run(
+        bytes_ops.strings_to_rows(lines_b, cfg.line_width), checkpoint_dir=ckpt
+    )
+    assert dict(res.to_host_pairs()) == {b"ccc": 32, b"ddd": 32}
+
+
+def test_hierarchical_stream_checkpoint(tmp_path):
+    """run_stream + checkpoint: resume re-reads but does not re-fold."""
+    cfg = _cfg(block_lines=2)
+    lines = [b"x y z", b"y z"] * 24
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    want = dict(py_wordcount(lines, cfg.emits_per_line, cfg.key_width))
+    ckpt = str(tmp_path / "hsckpt")
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    lpr = h.lines_per_round
+
+    def blocks():
+        for i in range(0, rows.shape[0], lpr):
+            yield rows[i : i + lpr]
+
+    res = h.run_stream(
+        blocks(), fingerprint="fp1", checkpoint_dir=ckpt
+    )
+    assert dict(res.to_host_pairs()) == want
+    # Second run with the same fingerprint: all rounds already folded.
+    real_step = h._step
+    h._step = lambda *a: (_ for _ in ()).throw(RuntimeError("stepped"))
+    res2 = h.run_stream(blocks(), fingerprint="fp1", checkpoint_dir=ckpt)
+    assert dict(res2.to_host_pairs()) == want
+    h._step = real_step
+
+    with pytest.raises(ValueError, match="fingerprint"):
+        h.run_stream(blocks(), checkpoint_dir=ckpt)
+
+
+def test_cross_engine_checkpoint_not_resumed(tmp_path):
+    """A flat-engine snapshot in the same dir with the same corpus
+    fingerprint must NOT be resumed by the hierarchical engine (their npz
+    counter schemas differ — resuming used to KeyError; engine identity
+    is bound into the stream fingerprint)."""
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.parallel.shuffle import DistributedMapReduce
+
+    cfg = _cfg(block_lines=2)
+    lines = [b"aa bb", b"bb cc"] * 16
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    ckpt = str(tmp_path / "shared")
+
+    flat = DistributedMapReduce(make_mesh(8), cfg)
+
+    def blocks(lpr):
+        for i in range(0, rows.shape[0], lpr):
+            yield rows[i : i + lpr]
+
+    flat.run_stream(
+        blocks(flat.lines_per_round), fingerprint="same-corpus",
+        checkpoint_dir=ckpt,
+    )
+
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    res = h.run_stream(
+        blocks(h.lines_per_round), fingerprint="same-corpus",
+        checkpoint_dir=ckpt,
+    )
+    want = dict(py_wordcount(lines, cfg.emits_per_line, cfg.key_width))
+    assert dict(res.to_host_pairs()) == want
