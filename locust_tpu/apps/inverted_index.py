@@ -335,6 +335,8 @@ class DistributedInvertedIndex:
         lines: list[bytes] | np.ndarray,
         doc_ids: np.ndarray,
         stats_sync_every: int = 16,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
     ) -> dict[bytes, list[int]]:
         cfg = self.cfg
         if not isinstance(lines, np.ndarray):
@@ -351,26 +353,79 @@ class DistributedInvertedIndex:
             (rows[r * lpr : (r + 1) * lpr], ids[r * lpr : (r + 1) * lpr])
             for r in range(nrounds)
         )
-        return self._run_rounds(chunks, stats_sync_every)
+        fingerprint = None
+        if checkpoint_dir is not None:
+            from locust_tpu.io.serde import fingerprint_corpus
+
+            # Doc ids are part of the corpus identity: the same lines with
+            # different sharding produce a different index.
+            fingerprint = fingerprint_corpus(
+                rows, doc_ids=fingerprint_corpus(ids), **self._identity()
+            )
+        return self._run_rounds(
+            chunks,
+            stats_sync_every,
+            fingerprint=fingerprint,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
+
+    def _identity(self) -> dict:
+        """Engine/pipeline/mesh identity bound into checkpoint
+        fingerprints (shuffle.DistributedMapReduce._identity mirror)."""
+        return dict(
+            engine="inverted_index",
+            cfg=repr(self.cfg),
+            mesh=f"{self.n_dev}x{self.axis}",
+            bin_capacity=self.bin_capacity,
+            pairs_capacity=self.pairs_capacity,
+        )
 
     def run_stream(
-        self, blocks, stats_sync_every: int = 16
+        self,
+        blocks,
+        stats_sync_every: int = 16,
+        fingerprint: str | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
     ) -> dict[bytes, list[int]]:
         """Bounded-memory variant: ``blocks`` yields
         ``(rows [<=lines_per_round, width], doc_ids [same length])`` chunk
         pairs — e.g. zip a ``StreamingCorpus(..., block_lines=
         self.lines_per_round)`` with a doc-id generator.  Only one chunk
-        plus the sharded pair table are ever resident.
+        plus the sharded pair table are ever resident.  Pass a corpus
+        ``fingerprint`` to enable checkpoint/resume.
         """
         from locust_tpu.io.loader import prefetch_blocks
+        from locust_tpu.parallel.shuffle import stream_checkpoint_fingerprint
 
-        return self._run_rounds(prefetch_blocks(blocks), stats_sync_every)
+        fingerprint = stream_checkpoint_fingerprint(
+            fingerprint, checkpoint_dir, self._identity()
+        )
+        return self._run_rounds(
+            prefetch_blocks(blocks),
+            stats_sync_every,
+            fingerprint=fingerprint,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
 
-    def _run_rounds(self, chunk_iter, stats_sync_every: int):
+    def _run_rounds(
+        self,
+        chunk_iter,
+        stats_sync_every: int,
+        fingerprint: str | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ):
         from jax.sharding import PartitionSpec as P
 
         from locust_tpu.parallel.mesh import shard_rows
-        from locust_tpu.parallel.shuffle import _gather_batch_host
+        from locust_tpu.parallel.shuffle import (
+            ShardedCheckpoint,
+            _gather_batch_host,
+            drive_checkpointed_rounds,
+        )
 
         cfg = self.cfg
         lpr = self.lines_per_round
@@ -391,6 +446,27 @@ class DistributedInvertedIndex:
         n_pairs = 0
         shuf_ovf = 0
         emit_ovf = 0
+        start_round = 0
+
+        ckpt = None
+        if checkpoint_dir is not None:
+            ckpt = ShardedCheckpoint(checkpoint_dir, fingerprint, sharding)
+            restored = ckpt.load()
+            if restored is not None:
+                start_round, extras, acc, leftover = restored
+                n_pairs = int(extras["n_pairs"])
+                shuf_ovf = int(extras["shuf_ovf"])
+                emit_ovf = int(extras["emit_ovf"])
+
+        def snapshot(next_round: int) -> None:
+            ckpt.snapshot(
+                next_round,
+                acc,
+                leftover,
+                n_pairs=np.int64(n_pairs),
+                shuf_ovf=np.int64(shuf_ovf),
+                emit_ovf=np.int64(emit_ovf),
+            )
 
         def on_sync(st) -> None:
             nonlocal n_pairs, shuf_ovf, emit_ovf
@@ -415,7 +491,9 @@ class DistributedInvertedIndex:
         round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
         from locust_tpu.parallel.shuffle import normalize_round_chunk
 
-        for rows_chunk, ids_chunk in chunk_iter:
+        def fold_round(chunk) -> None:
+            nonlocal acc, leftover
+            rows_chunk, ids_chunk = chunk
             ids_chunk = np.asarray(ids_chunk, dtype=np.int32)
             rows_chunk = np.asarray(rows_chunk, dtype=np.uint8)
             if rows_chunk.shape[0] != ids_chunk.shape[0]:
@@ -435,7 +513,11 @@ class DistributedInvertedIndex:
                 leftover,
             )
             round_stats.push(stats)
-        round_stats.flush()
+
+        drive_checkpointed_rounds(
+            chunk_iter, fold_round, round_stats, ckpt, snapshot,
+            checkpoint_every, start_round,
+        )
         if emit_ovf:
             # Missing postings make a silently-wrong index; unlike WordCount
             # (whose per-line cap is reference semantics, main.cu:141-144),

@@ -51,6 +51,7 @@ from locust_tpu.parallel.shuffle import (
     RoundStats,
     _round_up,
     build_shuffle_step,
+    drive_checkpointed_rounds,
     merge_stats_vectors,
     normalize_round_chunk,
     sized_bins,
@@ -280,19 +281,14 @@ class HierarchicalMapReduce:
         """
         from locust_tpu.io.loader import prefetch_blocks
 
-        if checkpoint_dir is not None and fingerprint is None:
-            raise ValueError(
-                "run_stream needs an explicit corpus fingerprint to "
-                "checkpoint (e.g. StreamingCorpus.fingerprint())"
-            )
-        if fingerprint is not None:
-            # Bind engine identity: the caller's fingerprint covers only
-            # the corpus (file identity), same pattern as engine.run_stream.
-            fingerprint = f"{fingerprint}:{self._identity()}"
+        from locust_tpu.parallel.shuffle import stream_checkpoint_fingerprint
+
         return self._run_rounds(
             prefetch_blocks(blocks),
             stats_sync_every,
-            fingerprint=fingerprint,
+            fingerprint=stream_checkpoint_fingerprint(
+                fingerprint, checkpoint_dir, self._identity()
+            ),
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
         )
@@ -385,22 +381,18 @@ class HierarchicalMapReduce:
             self._stats_merge, on_sync, stats_sync_every,
             fetch_fn=self._fetch_stats,
         )
-        last_snapshot = nrounds = start_round
-        for r, chunk in enumerate(chunk_iter):
-            if r < start_round:  # resume: skip already-folded rounds
-                continue
-            nrounds = r + 1
+
+        def fold_round(chunk) -> None:
+            nonlocal acc, leftover
             chunk = normalize_round_chunk(chunk, lpr, width)
             sharded = shard_rows(chunk, self.mesh, (self.slice_axis, self.data_axis))
             acc, leftover, stats = self._step(sharded, acc, leftover)
             round_stats.push(stats)
-            if ckpt is not None and (r + 1) % checkpoint_every == 0:
-                round_stats.flush()  # snapshots must persist correct counters
-                snapshot(r + 1)
-                last_snapshot = r + 1
-        round_stats.flush()
-        if ckpt is not None and last_snapshot != nrounds:
-            snapshot(nrounds)
+
+        drive_checkpointed_rounds(
+            chunk_iter, fold_round, round_stats, ckpt, snapshot,
+            checkpoint_every, start_round,
+        )
         drains_used = int(drains_by_slice.max())
 
         # The one DCN hop: cross-slice merge of the bounded tables.

@@ -183,6 +183,57 @@ class ShardedCheckpoint:
         os.replace(tmp, self.path)
 
 
+def stream_checkpoint_fingerprint(
+    fingerprint: str | None, checkpoint_dir: str | None, identity: dict
+) -> str | None:
+    """The run_stream fingerprint rule, one copy: checkpointing requires
+    an explicit corpus fingerprint, and the engine's identity is bound in
+    so no other engine/mesh/pipeline can resume the snapshot."""
+    if checkpoint_dir is not None and fingerprint is None:
+        raise ValueError(
+            "run_stream needs an explicit corpus fingerprint to "
+            "checkpoint (e.g. StreamingCorpus.fingerprint())"
+        )
+    if fingerprint is not None:
+        fingerprint = f"{fingerprint}:{identity}"
+    return fingerprint
+
+
+def drive_checkpointed_rounds(
+    chunk_iter,
+    body,
+    round_stats: "RoundStats",
+    ckpt: "ShardedCheckpoint | None",
+    snapshot,
+    checkpoint_every: int,
+    start_round: int,
+) -> None:
+    """The loop half of the snapshot protocol, one copy for every round
+    engine: resume-skip of already-folded rounds, stats flush BEFORE each
+    snapshot (snapshots must persist correct counters), the snapshot
+    cadence, and the final-snapshot rule (only when rounds ran past the
+    last snapshot).  ``body(chunk)`` folds one round and pushes its stats;
+    a body that raises leaves the last snapshot intact (no stale state).
+    """
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
+    last_snapshot = nrounds = start_round
+    for r, chunk in enumerate(chunk_iter):
+        if r < start_round:  # resume: re-read, don't re-fold
+            continue
+        nrounds = r + 1
+        body(chunk)
+        if ckpt is not None and (r + 1) % checkpoint_every == 0:
+            round_stats.flush()
+            snapshot(r + 1)
+            last_snapshot = r + 1
+    round_stats.flush()
+    if ckpt is not None and last_snapshot != nrounds:
+        snapshot(nrounds)
+
+
 class RoundStats:
     """Device-side stats accumulation with periodic host syncs.
 
@@ -667,18 +718,11 @@ class DistributedMapReduce:
         """
         from locust_tpu.io.loader import prefetch_blocks
 
-        if checkpoint_dir is not None and fingerprint is None:
-            raise ValueError(
-                "run_stream needs an explicit corpus fingerprint to "
-                "checkpoint (e.g. StreamingCorpus.fingerprint())"
-            )
-        if fingerprint is not None:
-            # Bind engine identity: the caller's fingerprint covers only
-            # the corpus (file identity), same pattern as engine.run_stream.
-            fingerprint = f"{fingerprint}:{self._identity()}"
         return self._run_rounds(
             prefetch_blocks(blocks),  # overlap host reads with rounds
-            fingerprint=fingerprint,
+            fingerprint=stream_checkpoint_fingerprint(
+                fingerprint, checkpoint_dir, self._identity()
+            ),
             shard_fn=shard_fn,
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
@@ -769,23 +813,18 @@ class DistributedMapReduce:
                 )
 
         round_stats = RoundStats(self._stats_merge, on_sync, stats_sync_every)
-        last_snapshot = start_round
-        nrounds = start_round
-        for r, chunk in enumerate(chunk_iter):
-            if r < start_round:  # resume: skip already-folded rounds
-                continue
-            nrounds = r + 1
+
+        def fold_round(chunk) -> None:
+            nonlocal acc, leftover
             chunk = normalize_round_chunk(chunk, lpr, width)
             sharded = (shard_fn or shard_rows)(chunk, self.mesh, self.axis)
             acc, leftover, stats = self._step(sharded, acc, leftover)
             round_stats.push(stats)
-            if ckpt is not None and (r + 1) % checkpoint_every == 0:
-                round_stats.flush()  # snapshots must persist correct counters
-                snapshot(r + 1)
-                last_snapshot = r + 1
-        round_stats.flush()
-        if ckpt is not None and last_snapshot != nrounds:
-            snapshot(nrounds)
+
+        drive_checkpointed_rounds(
+            chunk_iter, fold_round, round_stats, ckpt, snapshot,
+            checkpoint_every, start_round,
+        )
         if truncated:
             logger.warning(
                 "a shard's distinct keys exceeded its table capacity (%d); "
