@@ -239,6 +239,20 @@ def test_cli_mesh_slices_checkpoint(corpus_file, tmp_path, capsysbinary):
     assert _parse_table(capsysbinary.readouterr().out) == first
 
 
+def test_cli_mesh_slices_stream_checkpoint(corpus_file, tmp_path,
+                                           capsysbinary):
+    """The full composition: hierarchical engine + streaming ingest +
+    resumable snapshots."""
+    ckpt = str(tmp_path / "hsckpt")
+    args = [corpus_file, "--mesh", "--slices", "2", "--stream",
+            "--checkpoint-dir", ckpt] + _cfg_args()
+    assert cli.main(args) == 0
+    first = _parse_table(capsysbinary.readouterr().out)
+    assert first == dict(py_wordcount(CORPUS.splitlines(), 8))
+    assert cli.main(args) == 0  # resumes from the completed snapshot
+    assert _parse_table(capsysbinary.readouterr().out) == first
+
+
 def test_cli_slices_implies_mesh(corpus_file, capfd):
     """--slices without --mesh must not silently fall back to the
     single-device engine (code-review r3 finding)."""
