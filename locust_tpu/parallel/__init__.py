@@ -10,6 +10,7 @@ from locust_tpu.parallel.shuffle import (  # noqa: F401
     DistributedMapReduce,
     DistributedResult,
     RoundStats,
+    ShardedCheckpoint,
     partition_to_bins,
 )
 from locust_tpu.parallel.hierarchical import HierarchicalMapReduce  # noqa: F401
