@@ -158,6 +158,21 @@ def test_two_process_sample_sort(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_hierarchical_checkpoint_resume(tmp_path):
+    """Hierarchical crash+resume with the slice axis across processes:
+    the shared ShardedCheckpoint gather/scatter must round-trip the 2-D
+    [slice, data] sharding through per-process npz snapshots."""
+    ckpt = tmp_path / "hckpt"
+    ckpt.mkdir()
+    result = _run_workers(tmp_path, "hier_checkpoint", (str(ckpt),))
+    got = {k.encode(): v for k, v in result["pairs"]}
+    assert got == dict(_wordcount_oracle(result["n_lines"]))
+    assert result["resumed_rounds"] == result["nrounds"] - 2
+    assert (ckpt / "state.p0.npz").exists()
+    assert (ckpt / "state.p1.npz").exists()
+
+
+@pytest.mark.slow
 def test_two_process_hierarchical(tmp_path):
     """[2 slices x 2 devices] with the SLICE axis across process
     boundaries: per-round collectives stay intra-process (ICI analog),
