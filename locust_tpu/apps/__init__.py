@@ -9,4 +9,8 @@ from locust_tpu.apps.pagerank import (  # noqa: F401
     pagerank,
 )
 from locust_tpu.apps.sample_sort import DistributedSort, sort_strings  # noqa: F401
-from locust_tpu.apps.tfidf import build_tfidf, term_doc_counts  # noqa: F401
+from locust_tpu.apps.tfidf import (  # noqa: F401
+    build_tfidf,
+    term_doc_counts,
+    term_doc_counts_stream,
+)

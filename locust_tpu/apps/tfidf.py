@@ -93,7 +93,6 @@ def term_doc_counts(
     other channel to signal it.
     """
     cfg = cfg or EngineConfig()
-    cap = pairs_capacity or 2 * cfg.emits_per_block
     if not isinstance(lines, np.ndarray):
         rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
     else:
@@ -101,27 +100,26 @@ def term_doc_counts(
     ids = np.asarray(doc_ids, np.int32)
     if rows.shape[0] != ids.shape[0]:
         raise ValueError(f"{rows.shape[0]} lines but {ids.shape[0]} doc ids")
-    if ids.size and ids.min() < 0:
-        # The doc id rides a uint32 key lane; -1 would wrap to 2**32-1 and
-        # come back as a different key than the caller passed in.
-        raise ValueError(f"doc ids must be >= 0, got min {int(ids.min())}")
 
     bl = cfg.block_lines
-    nblocks = max(1, -(-rows.shape[0] // bl))
-    pad = nblocks * bl - rows.shape[0]
-    rows = np.concatenate([rows, np.zeros((pad, cfg.line_width), np.uint8)])
-    ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+    chunks = (
+        (rows[i : i + bl], ids[i : i + bl])
+        for i in range(0, max(rows.shape[0], 1), bl)
+    )
+    return _fold_tf_chunks(
+        chunks, cfg, pairs_capacity, allow_overflow, prefetch=False
+    )
 
-    acc = KVBatch.empty(cap, cfg.key_lanes + 1)
-    distinct_dev = jnp.int32(0)  # device scalars: no per-block host sync
-    overflow_dev = jnp.int32(0)
-    for b in range(nblocks):
-        sl = slice(b * bl, (b + 1) * bl)
-        acc, blk_distinct, blk_ovf = _fold_tf_jit(
-            acc, jnp.asarray(rows[sl]), jnp.asarray(ids[sl]), cfg, cap
-        )
-        distinct_dev = jnp.maximum(distinct_dev, blk_distinct)
-        overflow_dev = overflow_dev + blk_ovf
+
+def _finish_tf(
+    acc: KVBatch, distinct_dev, overflow_dev, cfg, cap, allow_overflow
+) -> dict[tuple[bytes, int], int]:
+    """Shared tail of the tf folds: loss checks + host decode.
+
+    Decodes the composite key NUMERICALLY (KVBatch.to_host_pairs would
+    NUL-strip the key bytes, eating a doc-id lane whose low bytes are
+    zero): word lanes -> bytes, doc lane -> int.
+    """
     if int(overflow_dev):
         msg = (
             f"tf-idf dropped {int(overflow_dev)} tokens beyond the "
@@ -137,9 +135,6 @@ def term_doc_counts(
             f"pairs_capacity ({cap}); pass a larger pairs_capacity"
         )
 
-    # Host decode, splitting the composite key NUMERICALLY (KVBatch
-    # .to_host_pairs would NUL-strip the key bytes, eating a doc-id lane
-    # whose low bytes are zero): word lanes -> bytes, doc lane -> int.
     lanes, values, valid = jax.device_get((acc.key_lanes, acc.values, acc.valid))
     live = np.asarray(valid)
     lanes = np.asarray(lanes)[live]
@@ -159,6 +154,67 @@ def term_doc_counts(
         # (same ~2^-64 story as the engine, engine.finalize_host_pairs).
         out[pair] = out.get(pair, 0) + int(count)
     return out
+
+
+def term_doc_counts_stream(
+    chunks,
+    cfg: EngineConfig | None = None,
+    pairs_capacity: int | None = None,
+    allow_overflow: bool = False,
+) -> dict[tuple[bytes, int], int]:
+    """Bounded-memory tf: ``chunks`` yields ``(rows [<=block_lines, width],
+    doc_ids [same length])`` pairs — e.g. zip a ``StreamingCorpus(path,
+    width, cfg.block_lines)`` with a doc-id generator.  Same result and
+    loss guarantees as ``term_doc_counts``; only one chunk plus the pair
+    table are ever resident, and the reader prefetches ahead of the fold.
+    """
+    return _fold_tf_chunks(
+        chunks, cfg or EngineConfig(), pairs_capacity, allow_overflow,
+        prefetch=True,
+    )
+
+
+def _fold_tf_chunks(
+    chunks, cfg, pairs_capacity, allow_overflow, prefetch: bool
+) -> dict[tuple[bytes, int], int]:
+    """The ONE tf fold loop behind both entry points (validation, padding,
+    accumulate); ``prefetch`` adds the reader thread for the streaming
+    path only — the in-memory path stays thread-free."""
+    from locust_tpu.io.loader import prefetch_blocks
+    from locust_tpu.parallel.shuffle import normalize_round_chunk
+
+    cap = pairs_capacity or 2 * cfg.emits_per_block
+    bl, w = cfg.block_lines, cfg.line_width
+    acc = KVBatch.empty(cap, cfg.key_lanes + 1)
+    distinct_dev = jnp.int32(0)  # device scalars: no per-block host sync
+    overflow_dev = jnp.int32(0)
+    if prefetch:
+        chunks = prefetch_blocks(chunks)
+    for rows_chunk, ids_chunk in chunks:
+        ids_chunk = np.asarray(ids_chunk, np.int32)
+        rows_chunk = np.asarray(rows_chunk, np.uint8)
+        if rows_chunk.shape[0] != ids_chunk.shape[0]:
+            raise ValueError(
+                f"chunk has {rows_chunk.shape[0]} lines but "
+                f"{ids_chunk.shape[0]} doc ids"
+            )
+        if ids_chunk.size and ids_chunk.min() < 0:
+            # The doc id rides a uint32 key lane; -1 would wrap to
+            # 2**32-1 and come back as a different key than passed in.
+            raise ValueError(
+                f"doc ids must be >= 0, got min {int(ids_chunk.min())}"
+            )
+        rows_chunk = normalize_round_chunk(rows_chunk, bl, w)
+        if ids_chunk.shape[0] < bl:
+            ids_chunk = np.concatenate(
+                [ids_chunk, np.zeros(bl - ids_chunk.shape[0], np.int32)]
+            )
+        acc, blk_distinct, blk_ovf = _fold_tf_jit(
+            acc, jnp.asarray(rows_chunk), jnp.asarray(ids_chunk), cfg, cap
+        )
+        distinct_dev = jnp.maximum(distinct_dev, blk_distinct)
+        overflow_dev = overflow_dev + blk_ovf
+    return _finish_tf(acc, distinct_dev, overflow_dev, cfg, cap, allow_overflow)
 
 
 def build_tfidf(

@@ -98,3 +98,39 @@ def test_multi_block_fold_matches_single_block():
     assert term_doc_counts(lines, docs, small, pairs_capacity=256) == (
         term_doc_counts(lines, docs, big, pairs_capacity=256)
     )
+
+
+def test_stream_matches_in_memory():
+    from locust_tpu.apps.tfidf import term_doc_counts_stream
+
+    lines = LINES * 9
+    docs = (np.arange(len(lines)) // 4).astype(np.int32)
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+    want = term_doc_counts(lines, docs, cfg, pairs_capacity=512)
+
+    from locust_tpu.core import bytes_ops
+
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+
+    def chunks():
+        for i in range(0, rows.shape[0], cfg.block_lines):
+            yield rows[i : i + cfg.block_lines], docs[i : i + cfg.block_lines]
+
+    got = term_doc_counts_stream(chunks(), cfg, pairs_capacity=512)
+    assert got == want
+
+
+def test_stream_rejects_negative_ids_and_overflow():
+    from locust_tpu.apps.tfidf import term_doc_counts_stream
+    from locust_tpu.core import bytes_ops
+
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=2)
+    rows = bytes_ops.strings_to_rows(LINES[:4], cfg.line_width)
+    with pytest.raises(ValueError, match="doc ids must be >= 0"):
+        term_doc_counts_stream(
+            [(rows, np.array([0, 1, -2, 3], np.int32))], cfg
+        )
+    with pytest.raises(ValueError, match="MISSING"):
+        term_doc_counts_stream(
+            [(rows, np.arange(4, dtype=np.int32))], cfg
+        )
