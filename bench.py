@@ -69,7 +69,8 @@ _KEY_WIDTH_ENV = os.environ.get("LOCUST_BENCH_KEY_WIDTH")
 # default and any evidence-tuned flip (the escape hatch every other tuned
 # knob already has via its LOCUST_BENCH_* var).  Empty means auto (like
 # the other knobs); anything else is a loud error, not a silent force-off
-# (validated in run_bench so the one-JSON-line contract still holds).
+# (validated at the top of main() so the one-JSON-line contract still
+# holds without poisoning scripts that merely import this module).
 _PALLAS_ENV = os.environ.get("LOCUST_BENCH_PALLAS") or None
 _PER_BACKEND = {
     "tpu": {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False},
@@ -86,24 +87,6 @@ MIN_TPU_ATTEMPT_S = float(os.environ.get("LOCUST_BENCH_MIN_ATTEMPT", 150))
 def emit(payload: dict) -> None:
     """The one driver-facing JSON line; everything else goes to stderr."""
     print(json.dumps(payload), flush=True)
-
-
-# Fail fast on a malformed env override — at import, before the
-# orchestrator can burn its whole TPU retry budget re-discovering the
-# same deterministic typo in every child — while still honoring the
-# one-JSON-line contract.
-if _PALLAS_ENV is not None and _PALLAS_ENV not in ("0", "1"):
-    emit(
-        {
-            "metric": "wordcount_throughput",
-            "value": 0.0,
-            "unit": "MB/s",
-            "vs_baseline": 0.0,
-            "error": f"LOCUST_BENCH_PALLAS must be '0' or '1', "
-                     f"got {_PALLAS_ENV!r}",
-        }
-    )
-    sys.exit(1)
 
 
 def error_payload(msg: str) -> dict:
@@ -178,69 +161,94 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
             == caps["emits_per_line"]
         )
 
-    # Evidence must never break a run (same stance as utils/artifacts.py):
-    # a malformed or stale row falls back to the static defaults.
-    try:
-        ab = _tpu_rows("engine_sort_mode_ab")
-        if ab and caps_match(ab[-1]):
-            modes = ab[-1].get("modes", {})
-            if modes:
-                best = max(
-                    modes, key=lambda m: (modes[m] or {}).get("mb_s", 0.0)
-                )
-                from locust_tpu.config import SORT_MODES
+    def side_mb(side) -> float:
+        """MB/s of one A/B side; a malformed/errored side (null, missing
+        mb_s) scores -1 so it can never win over a real measurement."""
+        if isinstance(side, dict) and isinstance(side.get("mb_s"), (int, float)):
+            return float(side["mb_s"])
+        return -1.0
 
-                if best in SORT_MODES:
-                    out["sort_mode"] = best
-                    print(
-                        f"[bench] evidence-tuned sort_mode={best} "
-                        f"({modes[best].get('mb_s')} MB/s in the last TPU A/B)",
-                        file=sys.stderr,
-                    )
+    # Evidence must never break a run (same stance as utils/artifacts.py),
+    # and one malformed row must not revert knobs validly adopted from
+    # OTHER kinds (ADVICE r3): each kind is guarded independently; the
+    # outer except stays as a last-resort backstop.
+    try:
+        try:
+            ab = _tpu_rows("engine_sort_mode_ab")
+            if ab and caps_match(ab[-1]):
+                modes = ab[-1].get("modes", {})
+                best = max(modes, key=lambda m: side_mb(modes.get(m)), default=None)
+                if best is not None and side_mb(modes.get(best)) > 0.0:
+                    from locust_tpu.config import SORT_MODES
+
+                    if best in SORT_MODES:
+                        out["sort_mode"] = best
+                        print(
+                            f"[bench] evidence-tuned sort_mode={best} "
+                            f"({modes[best].get('mb_s')} MB/s in the last TPU A/B)",
+                            file=sys.stderr,
+                        )
+        except Exception as e:  # noqa: BLE001 - skip this kind only
+            print(
+                f"[bench] sort-mode evidence skipped ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
         # Only adopt a block size measured AT the adopted sort mode — the
         # block_lines_ab row records which mode it swept with (older rows
         # predate the field and swept the historical default "hash"), so
         # the joint configuration is always one a window actually ran.
-        bl = _tpu_rows("block_lines_ab")
-        if bl:
-            row = bl[-1]
-            blocks = row.get("blocks", {})
-            if (
-                blocks
-                and caps_match(row)
-                and row.get("sort_mode", "hash") == out["sort_mode"]
-            ):
-                best = max(
-                    blocks, key=lambda b: (blocks[b] or {}).get("mb_s", 0.0)
-                )
-                out["block_lines"] = int(best)
-                print(
-                    f"[bench] evidence-tuned block_lines={best} "
-                    f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
-                    file=sys.stderr,
-                )
+        try:
+            bl = _tpu_rows("block_lines_ab")
+            if bl:
+                row = bl[-1]
+                blocks = row.get("blocks", {})
+                if (
+                    caps_match(row)
+                    and row.get("sort_mode", "hash") == out["sort_mode"]
+                ):
+                    best = max(
+                        blocks, key=lambda b: side_mb(blocks.get(b)), default=None
+                    )
+                    if best is not None and side_mb(blocks.get(best)) > 0.0:
+                        out["block_lines"] = int(best)
+                        print(
+                            f"[bench] evidence-tuned block_lines={best} "
+                            f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
+                            file=sys.stderr,
+                        )
+        except Exception as e:  # noqa: BLE001 - skip this kind only
+            print(
+                f"[bench] block-lines evidence skipped ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
         # use_pallas: adopt only a measured engine-level win, and only if
         # the row was swept AT the adopted (sort_mode, block_lines) —
         # same joint-measurement rule as above.  A side that errored has
         # no "mb_s" key and loses.
-        pa = _tpu_rows("engine_pallas_ab")
-        if pa:
-            row = pa[-1]
-            joint = (
-                caps_match(row)
-                and row.get("sort_mode", "hash") == out["sort_mode"]
-                and int(row.get("block_lines", 32768)) == out["block_lines"]
-            )
-            sides = row.get("pallas", {})
-            on = (sides.get("True") or {}).get("mb_s", 0.0)
-            off = (sides.get("False") or {}).get("mb_s", 0.0)
-            if joint and on > off > 0.0:
-                out["use_pallas"] = True
-                print(
-                    f"[bench] evidence-tuned use_pallas=True "
-                    f"({on} vs {off} MB/s in the last TPU A/B)",
-                    file=sys.stderr,
+        try:
+            pa = _tpu_rows("engine_pallas_ab")
+            if pa:
+                row = pa[-1]
+                joint = (
+                    caps_match(row)
+                    and row.get("sort_mode", "hash") == out["sort_mode"]
+                    and int(row.get("block_lines", 32768)) == out["block_lines"]
                 )
+                sides = row.get("pallas", {})
+                on = side_mb(sides.get("True"))
+                off = side_mb(sides.get("False"))
+                if joint and on > off > 0.0:
+                    out["use_pallas"] = True
+                    print(
+                        f"[bench] evidence-tuned use_pallas=True "
+                        f"({on} vs {off} MB/s in the last TPU A/B)",
+                        file=sys.stderr,
+                    )
+        except Exception as e:  # noqa: BLE001 - skip this kind only
+            print(
+                f"[bench] pallas evidence skipped ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
     except Exception as e:  # noqa: BLE001 - tuning is best-effort
         print(
             f"[bench] evidence tuning skipped ({type(e).__name__}: {e}); "
@@ -309,8 +317,12 @@ def bench_auto_caps(lines, label: str = "[bench]") -> tuple[int, int]:
 
     d = EngineConfig()
     t0 = time.perf_counter()
+    # Measure on the width-truncated view the engine actually sees (the
+    # same policy as cli.py --auto-caps): a token spanning the line_width
+    # boundary must produce identical caps at both sites, or a sweep
+    # row's caps could fail the bench's joint caps_match rule (ADVICE r3).
     kw, epl, max_tok, max_per_line = auto_caps(
-        lines, d.key_width, d.emits_per_line
+        [ln[: d.line_width] for ln in lines], d.key_width, d.emits_per_line
     )
     print(
         f"{label} corpus caps: max_token={max_tok}B max_tokens/line="
@@ -579,6 +591,17 @@ def orchestrate() -> int:
 
 
 def main() -> int:
+    # Fail fast on a malformed env override — before the orchestrator can
+    # burn its whole TPU retry budget re-discovering the same
+    # deterministic typo in every child.  Validated here rather than at
+    # import so scripts that `import bench` for its helpers (the sweep,
+    # scripts/opp_resume.py) get a normal namespace, not a bench-contract
+    # JSON line and sys.exit on their own stdout (ADVICE r3).
+    if _PALLAS_ENV is not None and _PALLAS_ENV not in ("0", "1"):
+        emit(error_payload(
+            f"LOCUST_BENCH_PALLAS must be '0' or '1', got {_PALLAS_ENV!r}"
+        ))
+        return 1
     if (
         os.environ.get("LOCUST_BENCH_BACKEND", "auto") == "auto"
         and not os.environ.get("LOCUST_BENCH_INNER")

@@ -190,7 +190,8 @@ def prefetch_blocks(blocks, depth: int = 2):
         except BaseException as e:  # noqa: BLE001 - relayed to consumer
             put_or_stop(_PrefetchError(e))
 
-    threading.Thread(target=reader, daemon=True).start()
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
     try:
         while True:
             item = q.get()
@@ -201,6 +202,24 @@ def prefetch_blocks(blocks, depth: int = 2):
             yield item
     finally:
         stop.set()
+        # Drain until the reader has exited: a single drain can race a
+        # put that was already past the stop check, leaving one staged
+        # block referenced by the queue until the daemon thread's next
+        # loop iteration (ADVICE r3).  When the reader is blocked on a
+        # put it polls stop every 0.1s, so a few join attempts suffice;
+        # BOUNDED because a reader stalled inside next(blocks) (wedged
+        # host read) never observes stop, and an unbounded join here
+        # would trade a one-block reference for a permanent hang of the
+        # consumer's own exception path.
+        for _ in range(5):
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            if not t.is_alive():
+                break
+            t.join(timeout=0.2)
         try:
             while True:
                 q.get_nowait()

@@ -260,6 +260,35 @@ def test_evidence_tuning_survives_malformed_rows(tmp_path, monkeypatch, capsys):
     assert bench._evidence_tuned_tpu_defaults(static) == static
 
 
+def test_evidence_tuning_guards_each_kind_independently(
+    tmp_path, monkeypatch, capsys
+):
+    """One malformed row of one kind must not revert knobs validly
+    adopted from well-formed rows of OTHER kinds (ADVICE r3: the old
+    single try/except discarded sort_mode + block_lines together when the
+    pallas row was malformed)."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hash": {"mb_s": 30.0}, "hashp": {"mb_s": 44.0}}}
+        ) + "\n")
+        # Null A/B sides in the OTHER kinds (exactly what artifacts.record's
+        # exception fallback can append) must leave the hashp adoption alone.
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu", "sort_mode": "hashp",
+             "blocks": {"16384": None, "32768": None}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hashp", "block_lines": 32768, "pallas": None}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned == {"block_lines": 32768, "sort_mode": "hashp",
+                     "use_pallas": False}
+
+
 def test_error_payload_shape():
     row = bench.error_payload("boom")
     assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}
