@@ -49,6 +49,28 @@ def test_tokenize_block_extracts_exact_tokens():
     assert int(res.overflow) == 0
 
 
+def test_tokenize_map_impls_equivalent():
+    """The MXU einsum formulation (TPU default) and the scatter+gather
+    formulation (CPU default, VERDICT r3 weak #4) must produce identical
+    keys/valid/overflow — including overflow lines, empty lines, NUL
+    bytes mid-line, and tokens longer than key_width."""
+    rng = np.random.default_rng(7)
+    alphabet = b"abcde ,.-;:'()\"\t\x00\r"
+    lines = [
+        bytes(rng.choice(list(alphabet), size=rng.integers(0, 60)))
+        for _ in range(32)
+    ] + [b"", b"x" * 50, b"one two three four five six seven eight"]
+    for kw in (8, 16):
+        cfg_e = small_cfg(map_impl="einsum", key_width=kw, emits_per_line=5)
+        cfg_g = small_cfg(map_impl="gather", key_width=kw, emits_per_line=5)
+        rows = jnp.asarray(bytes_ops.strings_to_rows(lines, cfg_e.line_width))
+        a = map_stage.tokenize_block(rows, cfg_e)
+        b = map_stage.tokenize_block(rows, cfg_g)
+        assert np.array_equal(np.asarray(a.keys), np.asarray(b.keys))
+        assert np.array_equal(np.asarray(a.valid), np.asarray(b.valid))
+        assert int(a.overflow) == int(b.overflow)
+
+
 def test_tokenize_overflow_counted_and_dropped():
     cfg = small_cfg(emits_per_line=4)
     line = b"one two three four five six"
