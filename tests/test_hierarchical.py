@@ -269,3 +269,42 @@ def test_cross_engine_checkpoint_not_resumed(tmp_path):
     )
     want = dict(py_wordcount(lines, cfg.emits_per_line, cfg.key_width))
     assert dict(res.to_host_pairs()) == want
+
+
+def test_debug_checks_verify_slice_replication(monkeypatch):
+    """LOCUST_DEBUG_CHECKS makes the check_vma=False replication claim
+    self-policing (VERDICT r3 next #8): a healthy run passes the
+    per-slice table-equality check; a combine that leaks slice-varying
+    data into the merge fires it loudly."""
+    monkeypatch.setenv("LOCUST_DEBUG_CHECKS", "1")
+    cfg = _cfg()
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    lines = LINES * 11
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = h.run(rows)  # healthy: check passes silently
+    assert dict(res.to_host_pairs()) == dict(
+        py_wordcount(lines, cfg.emits_per_line)
+    )
+
+    # Corrupt one slice: wrap the debug combine so slice 1's values are
+    # perturbed — exactly the failure mode (slice-varying data reaching
+    # the supposedly-replicated output) the check exists to catch.
+    from jax.sharding import PartitionSpec as P
+
+    orig = h._combine_dbg
+
+    def doctored(acc):
+        table, stats = orig(acc)
+        vals = np.asarray(table.values).copy()
+        per_slice = vals.reshape(h.n_slices, -1)
+        per_slice[1] += 1
+        import dataclasses
+
+        table = dataclasses.replace(
+            table, values=jax.numpy.asarray(per_slice.reshape(vals.shape))
+        )
+        return table, stats
+
+    h._combine_dbg = doctored
+    with pytest.raises(RuntimeError, match="slice-varying"):
+        h.run(rows)
