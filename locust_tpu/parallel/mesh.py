@@ -80,6 +80,34 @@ def initialize_multihost(
     )
 
 
+def scatter_host_array(arr, sharding) -> jax.Array:
+    """Place a HOST-REPLICATED array onto a (possibly multi-process)
+    sharding: each process serves its addressable shards by slicing.
+    ``make_array_from_callback`` is specified for multi-controller use,
+    unlike a plain ``device_put`` onto a sharding with non-addressable
+    devices (ADVICE r2 low #4).  The one scatter recipe shared by the
+    checkpoint resume path, ShardedPageRank's plan staging, and anything
+    else that builds global state on host."""
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def gather_host_array(x: jax.Array) -> np.ndarray:
+    """Fetch a (possibly multi-process sharded) array to host numpy.
+
+    Multi-process: every process gathers ALL shards (process_allgather
+    over DCN) and holds the identical full array; single-process: a plain
+    device_get.  The one fetch recipe shared by result gathers, the CLI's
+    shard report, and checkpoint snapshots."""
+    if jax.process_count() > 1:  # exercised by tests/test_multiprocess.py
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
+
+
 def shard_rows(rows: np.ndarray, mesh: jax.sharding.Mesh, axis_name: str = DATA_AXIS):
     """Place host rows onto the mesh, sharded along the line dimension.
 

@@ -847,48 +847,27 @@ def _scatter_batch_from_host(batch: KVBatch, sharding) -> KVBatch:
 
     The checkpoint snapshot holds the FULL gathered table on every process
     (_gather_batch_host), so each process can serve its addressable shards
-    by slicing — ``make_array_from_callback`` does exactly that and, unlike
-    a plain ``device_put`` onto a sharding with non-addressable devices,
-    is specified for multi-controller use (ADVICE r2, low #4).
+    by slicing (mesh.scatter_host_array).
     """
-
-    def put(x):
-        import numpy as np
-
-        arr = np.asarray(x)
-        return jax.make_array_from_callback(
-            arr.shape, sharding, lambda idx: arr[idx]
-        )
+    from locust_tpu.parallel.mesh import scatter_host_array
 
     return KVBatch(
-        key_lanes=put(batch.key_lanes),
-        values=put(batch.values),
-        valid=put(batch.valid),
+        key_lanes=scatter_host_array(batch.key_lanes, sharding),
+        values=scatter_host_array(batch.values, sharding),
+        valid=scatter_host_array(batch.valid, sharding),
     )
 
 
 def _gather_batch_host(table: KVBatch) -> KVBatch:
-    """Gather a (possibly multi-process sharded) KVBatch to host numpy.
+    """Gather a (possibly multi-process sharded) KVBatch to host numpy
+    (mesh.gather_host_array per leaf: process_allgather on a pod,
+    device_get single-process)."""
+    from locust_tpu.parallel.mesh import gather_host_array
 
-    Multi-process: every process gathers ALL shards (process_allgather over
-    DCN) and holds the identical full table.
-    """
-    import numpy as np
-
-    if jax.process_count() > 1:  # exercised by tests/test_multiprocess.py
-        from jax.experimental import multihost_utils
-
-        lanes, values, valid = multihost_utils.process_allgather(
-            (table.key_lanes, table.values, table.valid), tiled=True
-        )
-    else:
-        lanes, values, valid = jax.device_get(
-            (table.key_lanes, table.values, table.valid)
-        )
     return KVBatch(
-        key_lanes=np.asarray(lanes),
-        values=np.asarray(values),
-        valid=np.asarray(valid),
+        key_lanes=gather_host_array(table.key_lanes),
+        values=gather_host_array(table.values),
+        valid=gather_host_array(table.valid),
     )
 
 
