@@ -257,3 +257,18 @@ def select_backend(
     logger.warning("select_backend: falling back to CPU (%s)", detail)
     force_cpu()
     return "cpu"
+
+
+def select_backend_cli(mode: str, prog: str = "locust_tpu") -> str | None:
+    """CLI-entrypoint wrapper: resolve the backend with the CLI's probe
+    policy, print failures to stderr, return None on failure.  The ONE
+    policy both the WordCount driver (cli.py) and the workload-ladder
+    subcommands (cli_apps.py) use, so probe-timeout tuning can never
+    drift between entrypoints."""
+    try:
+        backend = select_backend(mode, probe_timeout_s=90, retries=2)
+    except RuntimeError as e:
+        print(f"{prog}: error: {e}", file=sys.stderr)
+        return None
+    print(f"[locust] backend: {backend}", file=sys.stderr)
+    return backend

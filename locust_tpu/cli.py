@@ -96,6 +96,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto: accelerator if its init probe passes, else CPU; "
                         "cpu: pin CPU and deregister the TPU plugin (immune to "
                         "a wedged tunnel); tpu: require an accelerator")
+    p.add_argument("--coordinator", default=None,
+                   help="multi-process pod launch: coordinator address "
+                        "host:port (jax.distributed.initialize); every "
+                        "process runs the same command with its own "
+                        "--process-id.  Requires --mesh; only process 0 "
+                        "prints the table.  Inside managed TPU "
+                        "environments pass --coordinator alone and the "
+                        "process count/id are auto-detected.")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="with --coordinator: total process count")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="with --coordinator: this process's index")
     p.add_argument("--trace", action="store_true",
                    help="print a wall-clock span report (load/run/output) "
                         "on stderr in addition to the stage report")
@@ -106,6 +118,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # Workload-ladder subcommands (PageRank / inverted index / TF-IDF,
+    # cli_apps.py).  Dispatch on the first argument so the reference's
+    # bare positional WordCount contract stays intact; a FILE literally
+    # named "pagerank" needs ./pagerank.
+    from locust_tpu.cli_apps import SUBCOMMANDS
+    from locust_tpu import cli_apps
+
+    if argv and argv[0] in SUBCOMMANDS:
+        return cli_apps.main(argv[0], argv[1:])
     args = build_parser().parse_args(argv)
     try:
         return _run(args)
@@ -116,17 +138,37 @@ def main(argv=None) -> int:
 
 def _run(args) -> int:
 
+    # Pod launch: join the coordination service BEFORE any in-process jax
+    # backend init (jax.distributed.initialize is a no-op too late once
+    # jax.devices() has run).  The same command line runs on every
+    # process with its own --process-id — the JAX-native analog of the
+    # reference's per-node [start, end) staged contract (main.cu:47-54).
+    multiproc = (
+        args.coordinator is not None
+        or args.num_processes is not None
+        or args.process_id is not None
+    )
+    if multiproc:
+        if not (args.mesh or args.slices):
+            print(
+                "mapreduce: error: --coordinator/--num-processes/"
+                "--process-id require --mesh",
+                file=sys.stderr,
+            )
+            return 2
+        from locust_tpu.parallel.mesh import initialize_multihost
+
+        initialize_multihost(
+            args.coordinator, args.num_processes, args.process_id
+        )
+
     # Backend resolution MUST precede any jax backend use: a wedged remote-
     # TPU plugin would otherwise hang even JAX_PLATFORMS=cpu runs
     # (locust_tpu/backend.py; VERDICT.md round-1 weak #1).
-    from locust_tpu.backend import select_backend
+    from locust_tpu.backend import select_backend_cli
 
-    try:
-        backend = select_backend(args.backend, probe_timeout_s=90, retries=2)
-    except RuntimeError as e:
-        print(f"mapreduce: error: {e}", file=sys.stderr)
+    if select_backend_cli(args.backend, prog="mapreduce") is None:
         return 1
-    print(f"[locust] backend: {backend}", file=sys.stderr)
 
     if args.slices and not args.mesh:
         args.mesh = True  # --slices implies the mesh engine; never ignore it
@@ -425,9 +467,15 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
 
         # Per-shard report: one hash shard per shard_capacity rows (the
         # hierarchical table has devs_per_slice shards, the flat one n_dev).
-        shard_live = np.asarray(
-            jax.device_get(res.table.valid)
-        ).reshape(-1, dmr.shard_capacity).sum(axis=1)
+        # Gather ONLY the valid mask through the multi-process-safe path —
+        # a plain device_get of the sharded table touches non-addressable
+        # devices on a pod, and the full-table gather would move
+        # key_lanes+values over DCN just to be discarded.
+        from locust_tpu.parallel.mesh import gather_host_array
+
+        shard_live = gather_host_array(res.table.valid).reshape(
+            -1, dmr.shard_capacity
+        ).sum(axis=1)
         for d in range(shard_live.shape[0]):
             print(
                 f"[locust] shard {d}: {int(shard_live[d])} keys",
@@ -475,7 +523,14 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
 
 def _print_table(pairs: list[tuple[bytes, int]], limit=None) -> None:
     """Final ``key<TAB>count`` table on stdout (analog of printKeyIntValues,
-    main.cu:126-134 — we print two columns, not its internal three)."""
+    main.cu:126-134 — we print two columns, not its internal three).  On a
+    multi-process pod every process holds the gathered table
+    (to_host_pairs allgathers); only process 0 prints so the pod's
+    combined stdout is one table, not N interleaved copies."""
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
     for k, v in pairs[: limit if limit is not None else len(pairs)]:
         sys.stdout.buffer.write(k + b"\t" + str(v).encode() + b"\n")
     sys.stdout.flush()

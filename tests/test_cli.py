@@ -262,3 +262,132 @@ def test_cli_slices_implies_mesh(corpus_file, capfd):
     assert "hierarchical mesh: 2 slice(s)" in captured.err
     got = _parse_table(captured.out.encode())
     assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+
+
+# ---------------------------------------------------------- workload ladder
+
+
+@pytest.fixture
+def edges_file(tmp_path):
+    """Small digraph with a comment line and a dangling node (3)."""
+    p = tmp_path / "edges.txt"
+    p.write_bytes(
+        b"# snap-style comment\n"
+        b"0 1\n1 2\n2 0\n0 2\n4 3\n4 0\n"
+    )
+    return str(p)
+
+
+def test_cli_pagerank_single_and_mesh_match(edges_file, capsysbinary):
+    """BASELINE.json configs[3] from the entrypoint: single-device and
+    --mesh (ShardedPageRank) agree with the library oracle."""
+    from locust_tpu.apps.pagerank import pagerank
+
+    src = np.array([0, 1, 2, 0, 4, 4], np.int32)
+    dst = np.array([1, 2, 0, 2, 3, 0], np.int32)
+    want = np.asarray(pagerank(src, dst, num_nodes=5, num_iters=10))
+
+    def parse(out: bytes) -> np.ndarray:
+        vals = {}
+        for ln in out.splitlines():
+            n, _, r = ln.partition(b"\t")
+            vals[int(n)] = float(r)
+        return np.asarray([vals[i] for i in range(len(vals))])
+
+    rc = cli.main(["pagerank", edges_file, "--num-iters", "10"])
+    assert rc == 0
+    got = parse(capsysbinary.readouterr().out)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    rc = cli.main(["pagerank", edges_file, "--num-iters", "10", "--mesh"])
+    assert rc == 0
+    got_mesh = parse(capsysbinary.readouterr().out)
+    np.testing.assert_allclose(got_mesh, want, atol=1e-5)
+
+
+def test_cli_pagerank_top_and_errors(edges_file, tmp_path, capsysbinary):
+    rc = cli.main(["pagerank", edges_file, "--top", "2"])
+    assert rc == 0
+    out = capsysbinary.readouterr().out.splitlines()
+    assert len(out) == 2
+    # Malformed edge file: loud failure, not a crash.
+    bad = tmp_path / "bad.txt"
+    bad.write_bytes(b"0 1\nnot an edge line\n")
+    assert cli.main(["pagerank", str(bad)]) == 1
+    # --num-nodes too small for the file's ids.
+    assert cli.main(["pagerank", edges_file, "--num-nodes", "2"]) == 1
+
+
+DOC_CORPUS = b"""the cat sat
+the dog ran
+cats and dogs
+the end
+"""
+
+
+def _index_oracle(lines, lines_per_doc=1):
+    import re
+
+    from locust_tpu.config import DELIMITERS
+
+    oracle = {}
+    for i, ln in enumerate(lines):
+        d = i // lines_per_doc
+        for t in re.split(b"[" + re.escape(DELIMITERS + b"\n\r\x00") + b"]+", ln):
+            if t:
+                docs = oracle.setdefault(t, [])
+                if d not in docs:
+                    docs.append(d)
+    return {k: sorted(v) for k, v in oracle.items()}
+
+
+def test_cli_index_single_and_mesh_match(tmp_path, capsysbinary):
+    """BASELINE.json configs[4] from the entrypoint."""
+    p = tmp_path / "docs.txt"
+    p.write_bytes(DOC_CORPUS)
+    want = _index_oracle(DOC_CORPUS.splitlines())
+
+    def parse(out: bytes):
+        got = {}
+        for ln in out.splitlines():
+            w, _, docs = ln.partition(b"\t")
+            got[w] = [int(d) for d in docs.split(b",")]
+        return got
+
+    args = ["index", str(p), "--block-lines", "8", "--line-width", "64",
+            "--emits-per-line", "8"]
+    assert cli.main(args) == 0
+    assert parse(capsysbinary.readouterr().out) == want
+    assert cli.main(args + ["--mesh"]) == 0
+    assert parse(capsysbinary.readouterr().out) == want
+    # Multi-line documents.
+    assert cli.main(args + ["--lines-per-doc", "2"]) == 0
+    assert parse(capsysbinary.readouterr().out) == _index_oracle(
+        DOC_CORPUS.splitlines(), 2
+    )
+
+
+def test_cli_tfidf_matches_library(tmp_path, capsysbinary):
+    p = tmp_path / "docs.txt"
+    p.write_bytes(DOC_CORPUS)
+    from locust_tpu.apps.tfidf import build_tfidf
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.io import loader
+
+    cfg = EngineConfig(block_lines=8, line_width=64, emits_per_line=8)
+    rows = loader.load_rows(str(p), 64)
+    ids = np.arange(rows.shape[0], dtype=np.int32)
+    want = build_tfidf(rows, ids, cfg)
+
+    assert cli.main(["tfidf", str(p), "--block-lines", "8", "--line-width",
+                     "64", "--emits-per-line", "8"]) == 0
+    out = capsysbinary.readouterr().out
+    got = {}
+    for ln in out.splitlines():
+        w, d, s = ln.split(b"\t")
+        got[(w, int(d))] = float(s)
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-4
+    # tfidf --mesh is a loud unsupported error, not silence.
+    assert cli.main(["tfidf", str(p), "--mesh"]) == 2
