@@ -25,7 +25,7 @@ DELIMITERS: bytes = b" ,.-;:'()\"\t"
 # The single source of truth for Process-stage sort strategies:
 # EngineConfig validation, the CLI --sort-mode choices, and
 # ops.process_stage.sort_and_compact dispatch all key off this.
-SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix", "lex")
+SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix", "bitonic", "lex")
 
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.
@@ -88,6 +88,10 @@ class EngineConfig:
     # table row, re-merged downstream (process_stage._folded_key).
     # "radix": same folded key sorted by O(n) LSD radix passes instead of
     # the comparison network (ops/radix_sort.py; loses 2.5-3x on TPU).
+    # "bitonic": hand-written Pallas bitonic network (ops/pallas/sort.py)
+    # over the folded key with payload carriage — tile-local compare
+    # passes fused in VMEM, ~10x fewer HBM round-trips than the stock
+    # network's operand streaming; interpret mode off-TPU.
     # "lex": sort full big-endian key lanes — exact lexicographic device
     # order, the reference's KIVComparator semantics (KeyValue.h:20-33).
     # Variant timings: scripts/bench_sort_variants.py -> artifacts/.

@@ -54,6 +54,8 @@ def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
         return _hash1_sort(batch)
     if mode == "radix":
         return _radix_sort(batch)
+    if mode == "bitonic":
+        return _bitonic_sort(batch)
     if mode == "lex":
         return _lex_sort(batch)
     raise ValueError(f"unknown sort mode {mode!r}")
@@ -179,4 +181,27 @@ def _radix_sort(batch: KVBatch) -> KVBatch:
     sidx = radix_argsort(_folded_key(batch))
     return KVBatch(
         key_lanes=lanes[sidx], values=values[sidx], valid=valid[sidx]
+    )
+
+
+def _bitonic_sort(batch: KVBatch) -> KVBatch:
+    """Hand-written Pallas bitonic network over the folded key, row as
+    payload (ops/pallas/sort.py): "hash1"'s single 31-bit-hash+validity
+    operand with "hashp"'s payload carriage, but the tile-local compare
+    passes run in VMEM instead of streaming HBM.  Interpret mode engages
+    automatically off-TPU (slow; CI uses small shapes)."""
+    from locust_tpu.ops.pallas.sort import bitonic_sort
+
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    n_lanes = lanes.shape[-1]
+    interpret = jax.default_backend() != "tpu"
+    key, pays = bitonic_sort(
+        _folded_key(batch),
+        tuple(lanes[:, i] for i in range(n_lanes)) + (values,),
+        interpret=interpret,
+    )
+    return KVBatch(
+        key_lanes=jnp.stack(pays[:n_lanes], axis=-1),
+        values=pays[n_lanes],
+        valid=key < jnp.uint32(0x80000000),
     )

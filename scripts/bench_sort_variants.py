@@ -130,6 +130,28 @@ def variant_g(lanes, values, valid):
     return jnp.sum(out[2]) + jnp.sum(out[-1].astype(jnp.uint32))
 
 
+def variant_h(lanes, values, valid):
+    """Pallas bitonic tiles (ops/pallas/sort.py): variant D's folded
+    single key with variant C's payload carriage, tile-local compare
+    passes fused in VMEM — the hand-written kernel the engine exposes as
+    sort_mode="bitonic"."""
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+    from locust_tpu.ops.pallas.sort import bitonic_sort
+
+    h1, _ = packing.hash_pair(lanes)
+    key = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    interpret = jax.default_backend() != "tpu"
+    _, pays = bitonic_sort(
+        key,
+        tuple(lanes[:, i] for i in range(L)) + (values,),
+        interpret=interpret,
+    )
+    return jnp.sum(pays[0]) + jnp.sum(pays[-1].astype(jnp.uint32))
+
+
 VARIANTS = [
     ("A_lex9", variant_a),
     ("B_hash3_gather", variant_b),
@@ -138,6 +160,7 @@ VARIANTS = [
     ("E_radix4x8", variant_e),
     ("F_radix6x6", variant_f),
     ("G_hash2_payload", variant_g),
+    ("H_bitonic_pallas", variant_h),
 ]
 
 

@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 # Engine sort modes covered by the end-to-end A/B (phase 3).
-AB_SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix")
+AB_SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix", "bitonic")
 
 
 def tunnel_gate() -> bool:

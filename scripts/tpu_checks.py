@@ -88,6 +88,52 @@ def main() -> int:
     }
     print(json.dumps(row), flush=True)
     artifacts.record("tpu_check", row)
+
+    # 3. Pallas bitonic Process-stage sort: Mosaic compile + host-verified
+    # correctness + A/B vs the best stock-sort mode at engine shape
+    # (VERDICT r3 next #2).  Error-isolated: a Mosaic lowering failure
+    # must leave checks 1-2's rows intact and still record the loss.
+    try:
+        import numpy as np
+
+        from locust_tpu.ops.pallas.sort import bitonic_sort
+
+        n = 65536 + 32768 * 20  # table + emits: the fold's true sort shape
+        rng = np.random.default_rng(3)
+        key = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+        pay = jnp.asarray(np.arange(n, dtype=np.int32))
+
+        sort_j = jax.jit(lambda k, p: bitonic_sort(k, (p,), interpret=False))
+        t0 = time.perf_counter()
+        sk, (sp,) = sort_j(key, pay)
+        jax.block_until_ready(sk)
+        compile_s = time.perf_counter() - t0
+        ok = bool(
+            np.array_equal(np.asarray(sk), np.sort(np.asarray(key)))
+            and np.array_equal(
+                np.asarray(key)[np.asarray(sp)], np.asarray(sk)
+            )
+        )
+
+        lax_j = jax.jit(lambda k, p: jax.lax.sort((k, p), num_keys=1))
+        bit_ms = best_ms(lambda: sort_j(key, pay)[0])
+        lax_ms = best_ms(lambda: lax_j(key, pay)[0])
+        row = {
+            "check": "bitonic_sort_ab",
+            "n": n,
+            "compile_s": round(compile_s, 1),
+            "matches_oracle": ok,
+            "bitonic_ms": round(bit_ms, 3),
+            "lax_sort_ms": round(lax_ms, 3),
+            "bitonic_speedup": round(lax_ms / bit_ms, 2),
+        }
+    except Exception as e:  # noqa: BLE001 - record the loss, keep the sweep
+        row = {
+            "check": "bitonic_sort_ab",
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }
+    print(json.dumps(row), flush=True)
+    artifacts.record("tpu_check", row)
     return 0
 
 

@@ -33,7 +33,7 @@ def main() -> int:
 
     # Phase 1: sort variants at the engine shape (table + block emits).
     env = dict(os.environ)
-    env["LOCUST_SORT_VARIANTS"] = "B,C,D,E,F,G"
+    env["LOCUST_SORT_VARIANTS"] = "B,C,D,E,F,G,H"
     env["N"] = str(65536 + 32768 * 20)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_sort_variants.py"),
