@@ -48,11 +48,12 @@ import numpy as np
 BASELINE_MB_S = 2.2
 TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_BYTES", 32 * 1024 * 1024))
 CPU_TARGET_BYTES = int(os.environ.get("LOCUST_BENCH_CPU_BYTES", 8 * 1024 * 1024))
-# Per-backend defaults, each overridable by env.  CPU: hash1 @ 16384 beat
-# hash @ 32768 by 16% (sweep committed in artifacts/bench_block_cpu_r3
-# .jsonl: 8k/16k/32k/64k -> 0.87/0.90/0.67/0.37 MB/s); TPU keeps the measured
-# configuration until the opportunistic sweep's on-hardware A/B says
-# otherwise (artifacts/tpu_runs.jsonl).
+# Per-backend defaults, each overridable by env.  CPU: hash1 remains the
+# clear winner after the r4 gather-map dispatch (grid re-tune committed in
+# artifacts/bench_block_cpu_r4.jsonl: hash1 ~5.1 MB/s vs hashp2 ~2.2 /
+# hashp ~1.9 at 8MB; block size 8k/16k/32k within noise, keep 16384); TPU
+# keeps the measured configuration until the opportunistic sweep's
+# on-hardware A/B says otherwise (artifacts/tpu_runs.jsonl).
 _BLOCK_LINES_ENV = os.environ.get("LOCUST_BENCH_BLOCK_LINES")
 _SORT_MODE_ENV = os.environ.get("LOCUST_BENCH_SORT_MODE")
 # emits_per_line cap (reference EMITS_PER_LINE=20, main.cu:19).  A smaller

@@ -1,0 +1,66 @@
+"""CPU bench grid: sort_mode x block_lines, post-gather-map re-tune.
+
+The CPU static defaults (bench._PER_BACKEND["cpu"]) were tuned in round 3
+BEFORE the backend-conditional map dispatch landed; the gather map shifts
+the stage balance, so the block/mode optimum may have moved.  Each cell
+is a full driver-path bench run in a child process (identical policy to
+the number the driver captures).  Appends one grid row to
+artifacts/bench_block_cpu_r4.jsonl.
+
+Usage: python scripts/bench_cpu_grid.py [modes] [blocks]
+  e.g. python scripts/bench_cpu_grid.py hash1,hashp2 8192,16384,32768
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    modes = (sys.argv[1] if len(sys.argv) > 1 else "hash1,hashp2,hashp").split(",")
+    blocks = [int(b) for b in
+              (sys.argv[2] if len(sys.argv) > 2 else "8192,16384,32768").split(",")]
+    grid = {}
+    for mode in modes:
+        for bl in blocks:
+            env = {
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "JAX_PLATFORMS": "cpu",
+                "LOCUST_BENCH_BACKEND": "cpu",
+                "LOCUST_BENCH_SORT_MODE": mode,
+                "LOCUST_BENCH_BLOCK_LINES": str(bl),
+            }
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True, timeout=600,
+            )
+            lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+            row = json.loads(lines[-1]) if lines else {"error": r.stderr[-200:]}
+            grid[f"{mode}@{bl}"] = {
+                "mb_s": row.get("value"), "distinct": row.get("distinct"),
+            }
+            print(f"[grid] {mode}@{bl}: {row.get('value')} MB/s",
+                  file=sys.stderr, flush=True)
+    out = {
+        "ts": round(time.time(), 1),
+        "kind": "cpu_bench_grid",
+        "backend": "cpu",
+        "corpus": "hamlet-replicated 8MB (driver CPU policy)",
+        "grid": grid,
+        "note": "post-gather-map re-tune (round 4)",
+    }
+    path = os.path.join(REPO, "artifacts", "bench_block_cpu_r4.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
