@@ -64,7 +64,18 @@ _MODE_OPERANDS = {
 }
 
 _RADIX_PASSES = 4  # ceil(32 key bits / 8-bit digits), ops/radix_sort.py
-_BITONIC_TILE_BITS = 15  # ops/pallas/sort.TILE_ROWS * 128 = 2^15 elements
+
+
+def _bitonic_tile_bits() -> int:
+    """log2 of the bitonic kernel's tile, from the SAME source the kernel
+    reads (ops/pallas/sort.TILE_ROWS, env-overridable) — a hardcoded copy
+    here would silently model the wrong pass count when the knob moves."""
+    try:
+        from locust_tpu.ops.pallas.sort import TILE_ROWS
+
+        return (TILE_ROWS * 128).bit_length() - 1
+    except Exception:  # pragma: no cover - roofline must never break a run
+        return 15
 
 
 def _row_u32(key_lanes: int) -> int:
@@ -83,7 +94,7 @@ def sort_pass_count(n_rows: int, mode: str = "hash") -> int:
         # HBM round-trips of the Pallas tiled network: one fused launch
         # for stages 1..m, then per outer stage its cross passes + one
         # fused tail (ops/pallas/sort.py module docstring).
-        m = min(k, _BITONIC_TILE_BITS)
+        m = min(k, _bitonic_tile_bits())
         return 1 + sum(s - m + 1 for s in range(m + 1, k + 1))
     return k * (k + 1) // 2
 

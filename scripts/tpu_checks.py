@@ -9,6 +9,7 @@ Run on hardware (the suite pins CPU):
 3. Prints one JSON line per check; artifact-friendly.
 """
 
+import functools
 import json
 import os
 import sys
@@ -134,6 +135,44 @@ def main() -> int:
         }
     print(json.dumps(row), flush=True)
     artifacts.record("tpu_check", row)
+
+    # 4. Bitonic tile sweep: where is the VMEM-residency/round-trip knee?
+    # Only worth the compiles if the kernel itself compiled above.  256
+    # (the default) reuses check 3's verified measurement — a flapping
+    # window should spend its seconds on the NEW tile points, each of
+    # which is oracle-checked before it may be recorded as a winner (the
+    # cross/local split depends on tile_rows, so timing an unverified
+    # tile could crown a wrong-output configuration).
+    if "error" not in row:
+        try:
+            sorted_keys = np.sort(np.asarray(key))
+            tiles = {"256": {"ms": row["bitonic_ms"], "compile_s": 0.0,
+                             "note": "from bitonic_sort_ab"}}
+            for tr in (128, 512, 1024):
+                f = jax.jit(functools.partial(
+                    bitonic_sort, tile_rows=tr, interpret=False
+                ))
+                t0 = time.perf_counter()
+                sk, _ = f(key, (pay,))
+                jax.block_until_ready(sk)
+                compile_s = time.perf_counter() - t0
+                if not np.array_equal(np.asarray(sk), sorted_keys):
+                    tiles[str(tr)] = {"error": "output not sorted"}
+                    continue
+                ms = best_ms(lambda f=f: f(key, (pay,))[0])
+                tiles[str(tr)] = {
+                    "ms": round(ms, 3), "compile_s": round(compile_s, 1),
+                }
+                print(f"[tpu_checks] bitonic tile {tr}: {ms:.1f}ms",
+                      file=sys.stderr, flush=True)
+            row = {"check": "bitonic_tile_ab", "n": n, "tiles": tiles}
+        except Exception as e:  # noqa: BLE001
+            row = {
+                "check": "bitonic_tile_ab",
+                "error": f"{type(e).__name__}: {e}"[:400],
+            }
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
     return 0
 
 
