@@ -40,6 +40,23 @@ TOKEN_BOUNDARY_EXTRA: bytes = b"\x00\n\r"
 FULL_DELIMITERS: bytes = DELIMITERS + TOKEN_BOUNDARY_EXTRA
 
 
+# Bitonic Pallas sort tile (rows of 128 lanes; ops/pallas/sort.py).
+# Parsed + validated HERE (jax-free) so both the kernel and the roofline
+# model (utils/roofline.py) read the one value — a drifted copy would
+# silently model the wrong HBM pass count.  Bigger tiles trade fewer HBM
+# round-trips for larger VMEM residency and longer unrolled kernels; the
+# on-hardware sweep (scripts/tpu_checks.py bitonic_tile_ab) measures the
+# knee.
+import os as _os
+
+BITONIC_TILE_ROWS: int = int(_os.environ.get("LOCUST_BITONIC_TILE_ROWS", 256))
+if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):
+    raise ValueError(
+        f"LOCUST_BITONIC_TILE_ROWS must be a power of two >= 8 "
+        f"(int32 min sublane tile), got {BITONIC_TILE_ROWS}"
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Static shape/capacity configuration of one MapReduce pipeline.

@@ -40,26 +40,21 @@ the on-hardware A/B rides scripts/opp_resume.py.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from locust_tpu.config import BITONIC_TILE_ROWS
+
 # Default tile: 2^15 elements = 256 rows x 128 lanes.  Working set per
 # operand = 128KB; key + 9 payload operands (key_width 32) = 1.25MB of
-# VMEM — comfortable, and m=15 leaves few cross stages.  Bigger tiles
-# trade fewer HBM round-trips for larger VMEM residency and longer
-# unrolled kernels (m=17 fuses 153 substages in the first launch) —
+# VMEM — comfortable, and m=15 leaves few cross stages.  Parsed and
+# validated in config.py (jax-free, shared with the roofline model);
 # $LOCUST_BITONIC_TILE_ROWS overrides, and the on-hardware tile sweep
 # (scripts/tpu_checks.py bitonic_tile_ab) measures where the knee is.
-TILE_ROWS = int(os.environ.get("LOCUST_BITONIC_TILE_ROWS", 256))
-if TILE_ROWS < 8 or TILE_ROWS & (TILE_ROWS - 1):
-    raise ValueError(
-        f"LOCUST_BITONIC_TILE_ROWS must be a power of two >= 8 "
-        f"(int32 min sublane tile), got {TILE_ROWS}"
-    )
+TILE_ROWS = BITONIC_TILE_ROWS
 
 _LANES = 128
 

@@ -67,15 +67,13 @@ _RADIX_PASSES = 4  # ceil(32 key bits / 8-bit digits), ops/radix_sort.py
 
 
 def _bitonic_tile_bits() -> int:
-    """log2 of the bitonic kernel's tile, from the SAME source the kernel
-    reads (ops/pallas/sort.TILE_ROWS, env-overridable) — a hardcoded copy
-    here would silently model the wrong pass count when the knob moves."""
-    try:
-        from locust_tpu.ops.pallas.sort import TILE_ROWS
+    """log2 of the bitonic kernel's tile, from the SAME validated value
+    the kernel reads (config.BITONIC_TILE_ROWS — jax-free, so this module
+    stays importable in analysis contexts) — a hardcoded copy here would
+    silently model the wrong pass count when the knob moves."""
+    from locust_tpu.config import BITONIC_TILE_ROWS
 
-        return (TILE_ROWS * 128).bit_length() - 1
-    except Exception:  # pragma: no cover - roofline must never break a run
-        return 15
+    return (BITONIC_TILE_ROWS * 128).bit_length() - 1
 
 
 def _row_u32(key_lanes: int) -> int:

@@ -206,20 +206,38 @@ def main() -> int:
     # takes minutes of XLA compile at bench shapes on TPU; skip it when
     # the tunnel-up window is short).
     sel = os.environ.get("LOCUST_SORT_VARIANTS")
-    chosen = [
-        (name, fn)
-        for name, fn in VARIANTS
-        if sel is None or name.split("_")[0] in sel.upper().split(",")
-    ]
+    if sel is None:
+        chosen = list(VARIANTS)
+    else:
+        # Env ORDER is priority order: a flapping tunnel window should
+        # spend its first compiles on the variants the caller cares about
+        # (the sweep puts the open questions first).  Unknown letters are
+        # a loud error — a mistyped selector must not silently consume a
+        # scarce window with zero measurements; duplicates dedupe.
+        by_letter = {name.split("_")[0]: (name, fn) for name, fn in VARIANTS}
+        chosen, bad = [], []
+        for s in dict.fromkeys(sel.upper().split(",")):
+            (chosen if s in by_letter else bad).append(
+                by_letter.get(s, s)
+            )
+        if bad or not chosen:
+            raise SystemExit(
+                f"LOCUST_SORT_VARIANTS: unknown variant letter(s) {bad}; "
+                f"known: {sorted(by_letter)}"
+            )
+    force = bool(os.environ.get("LOCUST_ARTIFACT_FORCE"))
     for name, fn in chosen:
         c, ms = timeit(fn, lanes, values, valid)
         results[name] = {"compile_s": round(c, 1), "run_ms": round(ms, 3)}
         print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}", flush=True)
-    artifacts.record(
-        "sort_variants",
-        {"n_rows": N, "key_lanes": L, "variants": results},
-        force=bool(os.environ.get("LOCUST_ARTIFACT_FORCE")),
-    )
+        # Record after EVERY variant: a window that closes mid-run keeps
+        # what it measured (consumers read the latest row of the kind).
+        artifacts.record(
+            "sort_variants",
+            {"n_rows": N, "key_lanes": L, "variants": dict(results),
+             "partial": name != chosen[-1][0]},
+            force=force,
+        )
     return 0
 
 

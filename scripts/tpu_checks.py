@@ -137,27 +137,38 @@ def main() -> int:
     artifacts.record("tpu_check", row)
 
     # 4. Bitonic tile sweep: where is the VMEM-residency/round-trip knee?
-    # Only worth the compiles if the kernel itself compiled above.  256
-    # (the default) reuses check 3's verified measurement — a flapping
+    # Only worth the compiles if check 3 compiled AND matched its oracle
+    # (a wrong-output configuration must never seed the sweep's baseline).
+    # The default tile reuses check 3's verified measurement — a flapping
     # window should spend its seconds on the NEW tile points, each of
-    # which is oracle-checked before it may be recorded as a winner (the
-    # cross/local split depends on tile_rows, so timing an unverified
-    # tile could crown a wrong-output configuration).
-    if "error" not in row:
+    # which is oracle-checked (keys sorted AND payload pairing intact:
+    # the cross/local split depends on tile_rows, so a tile-specific bug
+    # could scramble either) before it may be recorded as a winner.
+    if "error" not in row and row.get("matches_oracle"):
+        from locust_tpu.ops.pallas.sort import TILE_ROWS
+
         try:
-            sorted_keys = np.sort(np.asarray(key))
-            tiles = {"256": {"ms": row["bitonic_ms"], "compile_s": 0.0,
-                             "note": "from bitonic_sort_ab"}}
-            for tr in (128, 512, 1024):
+            key_np = np.asarray(key)
+            sorted_keys = np.sort(key_np)
+            tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
+                                      "compile_s": 0.0,
+                                      "note": "from bitonic_sort_ab"}}
+            for tr in (128, 256, 512, 1024):
+                if tr == TILE_ROWS:
+                    continue  # already measured (and verified) by check 3
                 f = jax.jit(functools.partial(
                     bitonic_sort, tile_rows=tr, interpret=False
                 ))
                 t0 = time.perf_counter()
-                sk, _ = f(key, (pay,))
+                sk, (sp,) = f(key, (pay,))
                 jax.block_until_ready(sk)
                 compile_s = time.perf_counter() - t0
-                if not np.array_equal(np.asarray(sk), sorted_keys):
-                    tiles[str(tr)] = {"error": "output not sorted"}
+                sk_np, sp_np = np.asarray(sk), np.asarray(sp)
+                if not (
+                    np.array_equal(sk_np, sorted_keys)
+                    and np.array_equal(key_np[sp_np], sk_np)
+                ):
+                    tiles[str(tr)] = {"error": "output failed oracle"}
                     continue
                 ms = best_ms(lambda f=f: f(key, (pay,))[0])
                 tiles[str(tr)] = {
