@@ -136,15 +136,24 @@ def _run_local(arrs, stages, tile_rows, interpret):
         tile_rows=tile_rows,
         n_ops=n_ops,
     )
+
+    def out_sds(a):
+        # Inside shard_map with check_vma=True, pallas outputs must state
+        # how they vary across mesh axes; the sort is elementwise over
+        # its own shard, so each output varies exactly like its (aliased)
+        # input.  Outside shard_map, vma is absent/empty — plain struct.
+        vma = getattr(jax.typeof(a), "vma", None)
+        if vma is not None:  # frozenset() (replicated) must pass through
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
     return list(
         pl.pallas_call(
             kernel,
             grid=(grid,),
             in_specs=[spec] * n_ops,
             out_specs=[spec] * n_ops,
-            out_shape=[
-                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs
-            ],
+            out_shape=[out_sds(a) for a in arrs],
             input_output_aliases={i: i for i in range(n_ops)},
             interpret=interpret,
         )(*arrs)

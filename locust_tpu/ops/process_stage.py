@@ -31,11 +31,16 @@ TPU-native formulations, selected by ``EngineConfig.sort_mode`` (also
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
+
+logger = logging.getLogger("locust_tpu")
+_warned_bitonic_fallback = False
 
 
 def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
@@ -189,14 +194,55 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
     payload (ops/pallas/sort.py): "hash1"'s single 31-bit-hash+validity
     operand with "hashp"'s payload carriage, but the tile-local compare
     passes run in VMEM instead of streaming HBM.  Interpret mode engages
-    automatically off-TPU (slow; CI uses small shapes)."""
-    from locust_tpu.ops.pallas.sort import bitonic_sort
+    automatically off-TPU (slow; CI uses small shapes).
 
+    Inside a ``shard_map`` manual trace the kernel cannot currently
+    trace: ``jnp.roll`` drops the varying-manual-axes type inside the
+    kernel body and ``check_vma`` rejects the mixed comparison (jax
+    issue; a ``pvary`` re-attach fails again in the interpret lowering's
+    physical-type re-trace).  There the mode falls back to the
+    semantically IDENTICAL stock formulation — same single folded-key
+    operand, same payload carriage via ``lax.sort`` — so mesh engines
+    accept sort_mode="bitonic" everywhere and the hand-written kernel
+    serves the single-device path (the headline bench's) until the jax
+    fix lands."""
     lanes, values, valid = batch.key_lanes, batch.values, batch.valid
     n_lanes = lanes.shape[-1]
+    folded = _folded_key(batch)
+    vma = frozenset().union(
+        *(
+            getattr(jax.typeof(x), "vma", None) or frozenset()
+            for x in (folded, lanes, values)
+        )
+    )
+    if vma:
+        # Loud once: evidence recorded as sort_mode="bitonic" on a mesh
+        # engine measured THIS stock formulation, not the Pallas kernel —
+        # a silent substitution would let a future A/B conclude the
+        # kernel gives no mesh speedup when it never ran.
+        global _warned_bitonic_fallback
+        if not _warned_bitonic_fallback:
+            _warned_bitonic_fallback = True
+            logger.warning(
+                "sort_mode='bitonic' inside shard_map: Pallas kernel "
+                "cannot trace under check_vma (jnp.roll drops vma); "
+                "using the equivalent stock lax.sort formulation — mesh "
+                "timings do NOT measure the hand-written kernel"
+            )
+        out = jax.lax.sort(
+            (folded, *(lanes[:, i] for i in range(n_lanes)), values),
+            num_keys=1,
+        )
+        return KVBatch(
+            key_lanes=jnp.stack(out[1 : 1 + n_lanes], axis=-1),
+            values=out[1 + n_lanes],
+            valid=out[0] < jnp.uint32(0x80000000),
+        )
+    from locust_tpu.ops.pallas.sort import bitonic_sort
+
     interpret = jax.default_backend() != "tpu"
     key, pays = bitonic_sort(
-        _folded_key(batch),
+        folded,
         tuple(lanes[:, i] for i in range(n_lanes)) + (values,),
         interpret=interpret,
     )

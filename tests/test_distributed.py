@@ -381,3 +381,25 @@ class TestRoundStats:
 
         with pytest.raises(ValueError, match="stats_sync_every"):
             RoundStats(merge_stats_vectors, lambda s: None, every=0)
+
+
+def test_mesh_engines_accept_bitonic_mode():
+    """sort_mode="bitonic" must work inside shard_map on every engine: the
+    Pallas kernel cannot trace under check_vma (jnp.roll drops the
+    varying-manual-axes type in the kernel body, jax issue), so
+    process_stage falls back to the semantically identical stock
+    single-operand formulation there — this pins that the fallback
+    engages instead of the trace error resurfacing."""
+    from helpers import py_wordcount
+
+    from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+    from locust_tpu.parallel.mesh import make_mesh, make_mesh_2d
+
+    lines = [b"to be or not to be", b"that is the question", b"the the"] * 8
+    cfg = small_cfg(sort_mode="bitonic")
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    want = dict(py_wordcount(lines, cfg.emits_per_line))
+    res = DistributedMapReduce(make_mesh(8), cfg).run(rows)
+    assert dict(res.to_host_pairs()) == want
+    res = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg).run(rows)
+    assert dict(res.to_host_pairs()) == want
