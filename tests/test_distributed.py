@@ -390,8 +390,6 @@ def test_mesh_engines_accept_bitonic_mode():
     process_stage falls back to the semantically identical stock
     single-operand formulation there — this pins that the fallback
     engages instead of the trace error resurfacing."""
-    from helpers import py_wordcount
-
     from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
     from locust_tpu.parallel.mesh import make_mesh, make_mesh_2d
 
