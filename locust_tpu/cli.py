@@ -225,7 +225,7 @@ def _run(args) -> int:
                     args.line_start, args.line_end,
                 )
                 auto_caps_fp = measure_stream.fingerprint()
-                max_tok, max_per_line = loader.measure_caps_rows(
+                max_tok, max_per_line = loader.measure_caps_stream(
                     measure_stream
                 )
             else:

@@ -65,6 +65,17 @@ def _load() -> ctypes.CDLL:
                 ctypes.c_long,
                 ctypes.c_long,
             ]
+            lib.ingest_measure_caps.restype = ctypes.c_long
+            lib.ingest_measure_caps.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+            ]
             lib.ingest_read_tsv.restype = ctypes.c_long
             lib.ingest_read_tsv.argtypes = [
                 ctypes.c_char_p,
@@ -75,6 +86,37 @@ def _load() -> ctypes.CDLL:
             ]
             _lib = lib
     return _lib
+
+
+def measure_caps(
+    path: str, width: int, line_start: int = -1, line_end: int = -1
+) -> tuple[int, int]:
+    """Single-pass (max token bytes, max tokens/line) over the
+    width-truncated [line_start, line_end) slice — the native fast path
+    behind io/loader.measure_caps_stream.  The delimiter set travels from
+    config.FULL_DELIMITERS so it can never drift from the device
+    tokenizer."""
+    from locust_tpu.config import FULL_DELIMITERS
+
+    lib = _load()
+    delims = (ctypes.c_ubyte * len(FULL_DELIMITERS)).from_buffer_copy(
+        FULL_DELIMITERS
+    )
+    max_tok = ctypes.c_long(0)
+    max_per_line = ctypes.c_long(0)
+    rc = lib.ingest_measure_caps(
+        str(path).encode(),
+        width,
+        line_start,
+        line_end,
+        delims,
+        len(FULL_DELIMITERS),
+        ctypes.byref(max_tok),
+        ctypes.byref(max_per_line),
+    )
+    if rc != 0:
+        raise OSError(f"native measure_caps failed on {path!r}")
+    return int(max_tok.value), int(max_per_line.value)
 
 
 def count_lines(path: str) -> int:

@@ -185,6 +185,98 @@ long ingest_load_window(const char* path, long* inout_offset,
   return row;
 }
 
+// Single-pass streaming caps measure: max token bytes + max tokens/line
+// over the WIDTH-TRUNCATED view of each line in [line_start, line_end) —
+// the same measurement io/loader.measure_caps_rows makes over staged row
+// blocks (a token is a maximal run of non-delimiter bytes within the
+// first `width` bytes; bytes past the truncation point are invisible, so
+// a run caps there and later tokens on the line don't exist).  The
+// delimiter set is PASSED IN (config.FULL_DELIMITERS) — a hardcoded copy
+// here would drift from the device tokenizer and let --auto-caps
+// under-size emits_per_line.  '\r' needs no special case: the windowed
+// loader strips a trailing CR, but CR is in the delimiter set so a
+// stripped-vs-kept CR closes the same token either way.  Floors are
+// (1, 1) like the Python sites.  Returns 0, or -1 on I/O error.
+long ingest_measure_caps(const char* path, long width, long line_start,
+                         long line_end, const unsigned char* delims,
+                         long n_delims, long* out_max_tok,
+                         long* out_max_per_line) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  bool lut[256] = {false};
+  for (long i = 0; i < n_delims; ++i) lut[delims[i]] = true;
+  lut[static_cast<unsigned char>('\n')] = true;  // line terminator anyway
+
+  const long B = 1 << 20;
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(B));
+  if (!buf) {
+    std::fclose(f);
+    return -1;
+  }
+  const long start = line_start < 0 ? 0 : line_start;
+  const long end = line_end;  // < 0 = unbounded
+  long line = 0, pos = 0, run = 0, toks = 0;
+  long max_tok = 1, max_per_line = 1;
+  bool in_line = false;
+  bool done = false;
+
+  // Close the current token run / line, folding into the maxima.
+  auto close_run = [&]() {
+    if (run > max_tok) max_tok = run;
+    run = 0;
+  };
+  auto close_line = [&]() {
+    close_run();
+    if (toks > max_per_line) max_per_line = toks;
+    ++line;
+    pos = 0;
+    toks = 0;
+    in_line = false;
+  };
+
+  while (!done) {
+    long got = static_cast<long>(std::fread(buf, 1, B, f));
+    if (got <= 0) {
+      // A mid-file read ERROR must not return caps measured from a
+      // prefix — silently undersized caps would drop real emits.
+      if (std::ferror(f)) {
+        std::free(buf);
+        std::fclose(f);
+        return -1;
+      }
+      break;  // clean EOF
+    }
+    for (long i = 0; i < got; ++i) {
+      if (end >= 0 && line >= end) {
+        done = true;
+        break;
+      }
+      const unsigned char c = buf[i];
+      if (c == '\n') {
+        close_line();
+        continue;
+      }
+      in_line = true;
+      const bool want = line >= start;
+      if (want && pos < width) {
+        if (lut[c]) {
+          close_run();
+        } else {
+          if (run == 0) ++toks;
+          ++run;
+        }
+      }
+      ++pos;
+    }
+  }
+  if (in_line && !done) close_line();  // trailing fragment (Q1 semantics)
+  std::free(buf);
+  std::fclose(f);
+  *out_max_tok = max_tok;
+  *out_max_per_line = max_per_line;
+  return 0;
+}
+
 // Streaming "key\tvalue" TSV parser — the native fast path for the
 // reduce stage's intermediate loads (python analog: io/serde.read_tsv;
 // reference analog: loadIntermediateFile, main.cu:66-103).  Semantics

@@ -371,7 +371,7 @@ def phase_stream() -> None:
 
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.io.corpus import write_corpus
-    from locust_tpu.io.loader import StreamingCorpus, measure_caps_rows, size_caps
+    from locust_tpu.io.loader import StreamingCorpus, measure_caps_stream, size_caps
     from locust_tpu.utils import artifacts
 
     from locust_tpu.config import EngineConfig
@@ -384,7 +384,7 @@ def phase_stream() -> None:
     t0 = time.perf_counter()  # other auto-caps site
     measure_stream = StreamingCorpus(path, d.line_width, 32768)
     fp = measure_stream.fingerprint()
-    max_tok, max_per_line = measure_caps_rows(measure_stream)
+    max_tok, max_per_line = measure_caps_stream(measure_stream)
     kw, epl = size_caps(max_tok, max_per_line, d.key_width, d.emits_per_line)
     print(f"[opp] stream caps: max_token={max_tok}B max_tokens/line="
           f"{max_per_line} -> key_width={kw} emits_per_line={epl} "

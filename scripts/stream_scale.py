@@ -111,7 +111,7 @@ def main() -> int:
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.io.loader import (
         StreamingCorpus,
-        measure_caps_rows,
+        measure_caps_stream,
         size_caps,
     )
     from locust_tpu.utils import artifacts
@@ -121,7 +121,7 @@ def main() -> int:
     t0 = time.perf_counter()
     measure_stream = StreamingCorpus(path, d.line_width, args.block_lines)
     fp = measure_stream.fingerprint()
-    max_tok, max_per_line = measure_caps_rows(measure_stream)
+    max_tok, max_per_line = measure_caps_stream(measure_stream)
     kw, epl = size_caps(max_tok, max_per_line, d.key_width, d.emits_per_line)
     measure_s = time.perf_counter() - t0
     print(

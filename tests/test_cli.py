@@ -104,14 +104,14 @@ def test_cli_auto_caps_stream_detects_corpus_mutation(tmp_path, monkeypatch,
 
     p = tmp_path / "in.txt"
     p.write_bytes(CORPUS)
-    orig = loader_mod.measure_caps_rows
+    orig = loader_mod.measure_caps_stream
 
-    def measure_then_mutate(blocks):
-        out = orig(blocks)
+    def measure_then_mutate(stream):
+        out = orig(stream)
         p.write_bytes(CORPUS + b"appended muchlongertokenthanmeasured line\n")
         return out
 
-    monkeypatch.setattr(loader_mod, "measure_caps_rows", measure_then_mutate)
+    monkeypatch.setattr(loader_mod, "measure_caps_stream", measure_then_mutate)
     rc = cli.main([str(p), "--stream", "--auto-caps"] + _cfg_args())
     assert rc == 1
     out, err = capsysbinary.readouterr()

@@ -389,3 +389,39 @@ class TestPrefetchBlocks:
         assert threading.active_count() <= before
         # The reader stopped far short of draining the 1000-item source.
         assert state["yielded"] < 50
+
+
+def test_native_measure_caps_parity(tmp_path):
+    """ingest_measure_caps == measure_caps_rows over the staged blocks —
+    on adversarial input (CR/NUL bytes, tokens spanning the truncation
+    boundary, empty lines, a trailing fragment without newline) across
+    widths and node slices.  The native scan is the --auto-caps --stream
+    fast path (~12x the numpy block path at 512MB)."""
+    pytest.importorskip("locust_tpu.io.native_ingest")
+    from locust_tpu.io import native_ingest
+
+    rng = np.random.default_rng(5)
+    alphabet = b"abcdef ,.-;:'()\"\t\r\x00"
+    lines = [
+        bytes(rng.choice(list(alphabet), size=int(rng.integers(0, 200))))
+        for _ in range(120)
+    ] + [b"", b"x" * 500, b"tok " * 60, (b"y" * 127) + b" zz",
+         (b"w" * 128) + b"qq more toks"]
+    p = tmp_path / "caps.txt"
+    p.write_bytes(b"\n".join(lines) + b"\ntail_without_newline")
+    try:
+        native_ingest.measure_caps(str(p), 64)
+    except OSError as e:  # toolchain missing
+        pytest.skip(f"native build unavailable: {e}")
+    for width in (64, 128):
+        for sl in ((-1, -1), (3, 60), (0, 1)):
+            want = loader.measure_caps_rows(
+                loader.StreamingCorpus(str(p), width, 32, *sl)
+            )
+            got = native_ingest.measure_caps(str(p), width, *sl)
+            assert got == want, (width, sl, got, want)
+    # measure_caps_stream prefers the native path and agrees too.
+    stream = loader.StreamingCorpus(str(p), 128, 32)
+    assert loader.measure_caps_stream(stream) == loader.measure_caps_rows(
+        loader.StreamingCorpus(str(p), 128, 32)
+    )
