@@ -36,12 +36,14 @@ import threading
 import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-# Repo-local persistent compilation cache, pre-seeded by any earlier TPU
-# session (committed under .jax_cache/): a fresh driver environment reuses
-# compiled executables, so a short tunnel-up window suffices end-to-end.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(_HERE, ".jax_cache")
-)
+# Machine-local persistent compilation cache: orchestrator retries and
+# repeat invocations in one environment reuse compiled executables.  NOT
+# the repo-committed directory any more — committed entries were CPU AOT
+# executables whose machine features need not match the host running the
+# bench (XLA loads them with a SIGILL-risk warning; the axon TPU backend
+# never serializes executables, so cross-machine pre-seeding bought
+# nothing and risked crashing the driver's CPU fallback).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
 
 import numpy as np
 
