@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import logging
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
