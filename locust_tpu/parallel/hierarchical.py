@@ -105,10 +105,19 @@ class HierarchicalMapReduce:
             if bin_capacity is not None
             else sized_bins(cfg.emits_per_block, self.devs_per_slice, skew_factor)
         )
+        # Same two-floor default as the flat engine: per-round receive
+        # volume OR this device's fair share of cfg.resolved_table_size
+        # (+ skew), whichever is larger — an explicitly raised table_size
+        # must not truncate at the emits-derived size (fuzz finding, r4).
         self.shard_capacity = (
             shard_capacity
             if shard_capacity is not None
-            else self.devs_per_slice * self.bin_capacity
+            else max(
+                self.devs_per_slice * self.bin_capacity,
+                sized_bins(
+                    cfg.resolved_table_size, self.devs_per_slice, skew_factor
+                ),
+            )
         )
         if self.shard_capacity < 1:
             raise ValueError(f"shard_capacity must be >= 1, got {self.shard_capacity}")

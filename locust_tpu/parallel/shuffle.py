@@ -551,13 +551,20 @@ class DistributedMapReduce:
         )
         # Result-table rows per device (its hash shard of the global table).
         # Decoupled from the per-round receive volume (n_dev * bin_capacity,
-        # the default) so a long corpus can accumulate a vocabulary far
-        # larger than one round's traffic; a shard's distinct keys exceeding
-        # this is reported via DistributedResult.truncated.
+        # one floor of the default) so a long corpus can accumulate a
+        # vocabulary far larger than one round's traffic; the OTHER floor
+        # is this device's fair share of cfg.resolved_table_size (+ skew),
+        # so an explicitly raised table_size carries over to the mesh
+        # engines instead of silently truncating at the emits-derived
+        # size (fuzz finding, r4).  Exceeding the capacity is reported
+        # via DistributedResult.truncated.
         self.shard_capacity = (
             shard_capacity
             if shard_capacity is not None
-            else self.n_dev * self.bin_capacity
+            else max(
+                self.n_dev * self.bin_capacity,
+                sized_bins(cfg.resolved_table_size, self.n_dev, skew_factor),
+            )
         )
         if self.shard_capacity < 1:
             raise ValueError(f"shard_capacity must be >= 1, got {self.shard_capacity}")

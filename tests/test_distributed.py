@@ -401,3 +401,34 @@ def test_mesh_engines_accept_bitonic_mode():
     assert dict(res.to_host_pairs()) == want
     res = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg).run(rows)
     assert dict(res.to_host_pairs()) == want
+
+
+def test_shard_capacity_honors_table_size():
+    """An explicitly raised cfg.table_size must carry over to the mesh
+    engines' default shard capacity: with tiny blocks the emits-derived
+    floor (n_dev * bin_capacity) is far below the user's table, and the
+    defaults used to truncate a vocabulary the user explicitly sized for
+    (r4 fuzz finding — loud, but wrong-by-surprise)."""
+    from helpers import py_wordcount
+
+    from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+    from locust_tpu.parallel.mesh import make_mesh, make_mesh_2d
+
+    cfg = small_cfg(block_lines=2, emits_per_line=4, table_size=4096)
+    # ~300 distinct words >> the old emits-derived capacity (64/32 rows).
+    lines = [b" ".join(b"w%d" % (7 * i + j) for j in range(4))
+             for i in range(100)]
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    want = dict(py_wordcount(lines, cfg.emits_per_line))
+
+    d = DistributedMapReduce(make_mesh(8), cfg)
+    assert d.shard_capacity >= 4096 // 8
+    res = d.run(rows)
+    assert not res.truncated
+    assert dict(res.to_host_pairs()) == want
+
+    h = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg)
+    assert h.shard_capacity >= 4096 // 4
+    hres = h.run(rows)
+    assert not hres.truncated
+    assert dict(hres.to_host_pairs()) == want
