@@ -132,11 +132,23 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
             for _ in range(3):
                 res = eng.run_blocks(blocks)
                 best = min(best, res.times.total_ms / 1e3)
+            import jax
+
+            from locust_tpu.utils import roofline
+
+            n_blocks = -(-rows_ab.shape[0] // 32768)
+            roof = roofline.summarize(
+                mode, eng.cfg.key_lanes, eng.cfg.emits_per_block,
+                eng.cfg.resolved_table_size, n_blocks, best,
+                jax.devices()[0].device_kind,
+            )
             results[mode] = {
                 "mb_s": round(corpus_bytes / 1e6 / best, 2),
                 "best_s": round(best, 4),
                 "compile_s": round(compile_s, 1),
                 "distinct": res.num_segments,
+                "sort_gb_s": roof["achieved_sort_gb_s"],
+                "hbm_utilization_pct": roof["hbm_utilization_pct"],
             }
         except Exception as e:  # noqa: BLE001 - one mode must not kill the
             # phase: bitonic runs first and a Mosaic reject there would
