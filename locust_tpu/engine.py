@@ -508,7 +508,9 @@ class MapReduceEngine:
         truncated = num > acc.size
         if truncated:
             logger.warning(
-                "distinct keys (%d) exceeded table capacity (%d); tail dropped",
+                "distinct keys (%d) exceeded table capacity (%d); tail "
+                "dropped — raise table_size (or block_lines: the default "
+                "capacity is min(65536, one block's emits))",
                 num,
                 acc.size,
             )
