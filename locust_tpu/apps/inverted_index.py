@@ -101,6 +101,17 @@ def _fold_index_block(
 _fold_index_jit = jax.jit(_fold_index_block, static_argnames=("cfg", "cap"))
 
 
+def default_pairs_capacity(cfg: EngineConfig, mult: int = 2) -> int:
+    """Default distinct-(word, doc) pair capacity: ``mult`` rounds of
+    emits with a 4096 floor.  The pair table is CORPUS-level state, not
+    per-block — a small block size must not shrink it (r4 apps battery:
+    tiny-block configs raised on ordinary vocabularies; the floor costs
+    ~150KB).  The ONE sizing rule for the single-device index, the
+    distributed index (``mult=4``: pairs accumulate across rounds), and
+    the tf counter."""
+    return max(mult * cfg.emits_per_block, 4096)
+
+
 def build_inverted_index(
     lines: list[bytes] | np.ndarray,
     doc_ids: np.ndarray,
@@ -111,11 +122,12 @@ def build_inverted_index(
 
     Streams the corpus through fixed-shape blocks like the WordCount engine
     — no line-count cap.  ``pairs_capacity`` bounds the distinct (word, doc)
-    pair table carried across blocks (default 2x emits_per_block); exceeding
-    it raises, since a truncated index is silently wrong.
+    pair table carried across blocks (default ``default_pairs_capacity``:
+    2x emits_per_block, floor 4096); exceeding it raises, since a
+    truncated index is silently wrong.
     """
     cfg = cfg or EngineConfig()
-    cap = pairs_capacity or 2 * cfg.emits_per_block
+    cap = pairs_capacity or default_pairs_capacity(cfg)
     if not isinstance(lines, np.ndarray):
         rows = bytes_ops.strings_to_rows(list(lines), cfg.line_width)
     else:
@@ -219,7 +231,7 @@ class DistributedInvertedIndex:
         # (a truncated index is silently wrong, like the single-device API).
         # Pairs accumulate across ALL rounds, so the floor is deliberately
         # larger than one round's emits.
-        self.pairs_capacity = pairs_capacity or max(4 * cfg.emits_per_block, 4096)
+        self.pairs_capacity = pairs_capacity or default_pairs_capacity(cfg, mult=4)
         self.max_drain_rounds = 2 + -(-cfg.emits_per_block // self.bin_capacity)
         max_drains = self.max_drain_rounds
         n_lanes = cfg.key_lanes

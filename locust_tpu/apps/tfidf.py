@@ -86,7 +86,8 @@ def term_doc_counts(
     """Host API: lines + per-line doc ids -> {(word, doc id): count}.
 
     Streams fixed-shape blocks like the WordCount engine.  Exceeding
-    ``pairs_capacity`` (default 2x emits_per_block) raises, and so does
+    ``pairs_capacity`` (default ``default_pairs_capacity``: 2x
+    emits_per_block, floor 4096) raises, and so does
     dropping tokens past the per-line emit cap (unless
     ``allow_overflow=True`` downgrades that to a warning) — either loss
     makes tf-idf scores silently wrong, and a plain dict return has no
@@ -183,7 +184,9 @@ def _fold_tf_chunks(
     from locust_tpu.io.loader import prefetch_blocks
     from locust_tpu.parallel.shuffle import normalize_round_chunk
 
-    cap = pairs_capacity or 2 * cfg.emits_per_block
+    from locust_tpu.apps.inverted_index import default_pairs_capacity
+
+    cap = pairs_capacity or default_pairs_capacity(cfg)
     bl, w = cfg.block_lines, cfg.line_width
     acc = KVBatch.empty(cap, cfg.key_lanes + 1)
     distinct_dev = jnp.int32(0)  # device scalars: no per-block host sync
