@@ -359,7 +359,7 @@ def run_bench(backend: str) -> dict:
     # (distinct=5608 both widths).  Caps never exceed the defaults AND
     # bench_engine_config pins table_size to what the DEFAULT
     # emits_per_line would resolve (a smaller cap would otherwise shrink
-    # resolved_table_size = min(65536, block_lines*emits_per_line) and
+    # resolved_table_size = min(65536, max(block_lines*emits_per_line, 4096)) and
     # truncate keys the default config keeps), so the result is always
     # byte-identical to a default-config run.
     if _EMITS_ENV and _KEY_WIDTH_ENV:

@@ -84,7 +84,8 @@ class EngineConfig:
     # Bounds the cross-block merge cost (the merge sorts table_size +
     # emits_per_block rows, not 2 x emits_per_block); a corpus with more
     # distinct keys than this reports truncation (RunResult.truncated).
-    # None (default) resolves to min(65536, emits_per_block) — measured the
+    # None (default) resolves to min(65536, max(emits_per_block, 4096))
+    # (see resolved_table_size for the floor's rationale) — measured the
     # fastest setting at both 5k and 100k vocabularies
     # (artifacts/bench_table_size_cpu_r2.jsonl); vocabularies past 2^16
     # distinct keys must raise it explicitly (tests/test_scale.py pins the
@@ -161,10 +162,19 @@ class EngineConfig:
 
     @property
     def resolved_table_size(self) -> int:
-        """Accumulator capacity with the None default resolved."""
+        """Accumulator capacity with the None default resolved.
+
+        ``min(65536, emits_per_block)`` measured fastest at bench shapes
+        (artifacts/bench_table_size_cpu_r2.jsonl), but the 4096 FLOOR is
+        a usability guard the round-4 batteries earned three times over:
+        the table is CORPUS-level state, and a small block size (e.g.
+        block_lines=4 -> 32 emits) used to cap the entire vocabulary at
+        32 keys — loudly, per contract, but on completely ordinary
+        inputs.  The floor costs ~150KB and binds only where
+        emits_per_block < 4096, far below any tuned shape."""
         if self.table_size is not None:
             return self.table_size
-        return min(1 << 16, self.emits_per_block)
+        return min(1 << 16, max(self.emits_per_block, 4096))
 
 
 DEFAULT_CONFIG = EngineConfig()

@@ -180,7 +180,9 @@ def test_engine_output_is_key_sorted():
 def test_truncation_flag_survives_later_merges():
     """Regression: truncation in an EARLY merge must be reported even when the
     final merge's distinct count fits the table capacity."""
-    cfg = small_cfg(block_lines=2, emits_per_line=4)  # capacity = 8 rows
+    # Explicit tiny table: the DEFAULT now floors at 4096 (config.py), and
+    # this test's subject is the truncation-flag carry, not the default.
+    cfg = small_cfg(block_lines=2, emits_per_line=4, table_size=8)
     lines = [
         b"a b c d",       # block 1: 8 distinct
         b"e f g h",
