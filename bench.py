@@ -546,8 +546,12 @@ def orchestrate() -> int:
     process — each attempt is internally probed/watchdogged and cannot
     hang — until one succeeds or only the CPU-fallback reserve remains.
     Child processes re-probe naturally as the backend.py fail-marker
-    (120s TTL) expires.  With the pre-seeded compilation cache a single
-    ~3-minute tunnel-up window fits probe + compile + steady-state runs.
+    (120s TTL) expires.  Each fresh environment pays one first compile
+    (~20-40s) inside its first successful attempt — the machine-local
+    /tmp cache only helps repeat attempts on the same machine (the axon
+    TPU backend never serializes executables, so there is no committed
+    pre-seed) — so a usable window needs probe + one compile +
+    steady-state runs, roughly 3-4 minutes end-to-end.
     """
     deadline = time.monotonic() + TIMEOUT_S
     attempt = 0

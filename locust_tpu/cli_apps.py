@@ -97,7 +97,11 @@ def load_edges(path: str) -> tuple[np.ndarray, np.ndarray]:
 
 def run_pagerank(args) -> int:
     src, dst = load_edges(args.edges)
-    n = args.num_nodes or int(max(src.max(), dst.max())) + 1
+    n = (
+        args.num_nodes
+        if args.num_nodes is not None
+        else int(max(src.max(), dst.max())) + 1
+    )
     if max(int(src.max()), int(dst.max())) >= n:
         print(
             f"locust_tpu: error: --num-nodes {n} but max node id is "
@@ -126,7 +130,7 @@ def run_pagerank(args) -> int:
         )
     order = (
         np.argsort(-ranks, kind="stable")[: args.top]
-        if args.top
+        if args.top is not None
         else np.arange(n)
     )
     out = sys.stdout
@@ -147,8 +151,6 @@ def _load_docs(args):
         emits_per_line=args.emits_per_line,
     )
     rows = loader.load_rows(args.filename, cfg.line_width)
-    if args.lines_per_doc < 1:
-        raise ValueError("--lines-per-doc must be >= 1")
     ids = (np.arange(rows.shape[0]) // args.lines_per_doc).astype(np.int32)
     return cfg, rows, ids
 
@@ -175,14 +177,6 @@ def run_index(args) -> int:
 
 
 def run_tfidf(args) -> int:
-    if args.mesh:
-        print(
-            "locust_tpu: error: tfidf has no mesh variant (the tf pair "
-            "table is device-bounded; use index --mesh for the "
-            "distributed path)",
-            file=sys.stderr,
-        )
-        return 2
     cfg, rows, ids = _load_docs(args)
     from locust_tpu.apps.tfidf import build_tfidf
 
@@ -201,6 +195,29 @@ def run_tfidf(args) -> int:
 
 def main(cmd: str, argv) -> int:
     args = build_parser(cmd).parse_args(argv)
+    # Pure argument validation BEFORE backend resolution: a trivially
+    # invalid invocation must not burn ~3 minutes of TPU probe/retry
+    # against a flapping tunnel before its error prints.
+    if cmd == "tfidf" and args.mesh:
+        print(
+            "locust_tpu: error: tfidf has no mesh variant (the tf pair "
+            "table is device-bounded; use index --mesh for the "
+            "distributed path)",
+            file=sys.stderr,
+        )
+        return 2
+    if cmd != "pagerank" and args.lines_per_doc < 1:
+        print("locust_tpu: error: --lines-per-doc must be >= 1",
+              file=sys.stderr)
+        return 2
+    if cmd == "pagerank":
+        if args.num_nodes is not None and args.num_nodes < 1:
+            print("locust_tpu: error: --num-nodes must be >= 1",
+                  file=sys.stderr)
+            return 2
+        if args.top is not None and args.top < 1:
+            print("locust_tpu: error: --top must be >= 1", file=sys.stderr)
+            return 2
     from locust_tpu.backend import select_backend_cli
 
     if select_backend_cli(args.backend) is None:

@@ -509,8 +509,8 @@ class MapReduceEngine:
         if truncated:
             logger.warning(
                 "distinct keys (%d) exceeded table capacity (%d); tail "
-                "dropped — raise table_size (or block_lines: the default "
-                "capacity is min(65536, one block's emits))",
+                "dropped — raise table_size (the default capacity is "
+                "min(65536, max(one block's emits, 4096)))",
                 num,
                 acc.size,
             )

@@ -200,6 +200,17 @@ def bitonic_sort(
     sort hash keys whose grouping semantics tolerate that, exactly like
     lax.sort's use in the "hash*" modes.  Arrays smaller than one tile
     shrink the tile to fit (floor 8 rows, the int32 min sublane tile).
+
+    PAD-SENTINEL CAVEAT: rows whose key is exactly 0xFFFFFFFF tie with
+    the pad rows, and since ties reorder arbitrarily, the ``[:n]`` slice
+    may keep a pad row (zero payloads) in place of a real sentinel-keyed
+    row — the sentinel-run PAYLOADS are then not a permutation of the
+    inputs.  Callers must either keep keys < 0xFFFFFFFF or not care
+    about sentinel-row payloads.  The engine's "bitonic" mode is safe by
+    construction: its folded key reserves 0xFFFFFFFF for INVALID rows
+    (process_stage._folded_key), whose payloads are dead downstream
+    (valid=False) — pinned by a test.  The on-hardware checkers generate
+    keys < 0xFFFFFFFF for the same reason.
     """
     n = key.shape[0]
     if key.dtype != jnp.uint32:

@@ -235,12 +235,17 @@ class HierarchicalMapReduce:
         (the table is bounded by shard_capacity) and loud — the
         replication argument stops being a comment and becomes a runtime
         invariant."""
+        from locust_tpu.parallel.mesh import gather_host_array
+
         table, stats = self._combine_dbg(acc)
+        # gather_host_array, NOT np.asarray: on a multi-process pod the
+        # debug outputs span non-addressable devices and a plain fetch
+        # would crash the check exactly where it matters most.
         parts = {
-            "key_lanes": np.asarray(table.key_lanes),
-            "values": np.asarray(table.values),
-            "valid": np.asarray(table.valid),
-            "stats": np.asarray(stats),
+            "key_lanes": gather_host_array(table.key_lanes),
+            "values": gather_host_array(table.values),
+            "valid": gather_host_array(table.valid),
+            "stats": gather_host_array(stats),
         }
         for name, arr in parts.items():
             per_slice = arr.reshape(self.n_slices, -1)

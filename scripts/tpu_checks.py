@@ -101,7 +101,9 @@ def main() -> int:
 
         n = 65536 + 32768 * 20  # table + emits: the fold's true sort shape
         rng = np.random.default_rng(3)
-        key = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+        # < 0xFFFFFFFF: the pad sentinel ties with real rows and may
+        # displace their payloads (bitonic_sort docstring caveat).
+        key = jnp.asarray(rng.integers(0, 2**32 - 1, n, dtype=np.uint32))
         pay = jnp.asarray(np.arange(n, dtype=np.int32))
 
         sort_j = jax.jit(lambda k, p: bitonic_sort(k, (p,), interpret=False))

@@ -1,0 +1,77 @@
+"""Direct unit tests for the Pallas bitonic sort kernel (interpret mode).
+
+Engine-level coverage lives in test_pipeline/test_tfidf/test_distributed;
+these pin the kernel's own contract: ascending keys, payload permutation,
+non-power-of-two padding, multi-tile cross stages, and the documented
+pad-sentinel caveat (code-review r4 finding)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from locust_tpu.ops.pallas.sort import bitonic_sort
+
+
+@pytest.mark.parametrize("n,tile_rows", [(1024, 8), (5000, 8), (8192, 16)])
+def test_sorts_and_permutes_payload(n, tile_rows):
+    rng = np.random.default_rng(n)
+    # Keys < 0xFFFFFFFF: the documented precondition for exact payload
+    # permutation (the pad sentinel ties otherwise).
+    keys = rng.integers(0, 2**32 - 1, n, dtype=np.uint32)
+    idx = np.arange(n, dtype=np.int32)
+    sk, (si,) = jax.jit(
+        lambda k, i: bitonic_sort(k, (i,), tile_rows=tile_rows, interpret=True)
+    )(jnp.asarray(keys), jnp.asarray(idx))
+    sk, si = np.asarray(sk), np.asarray(si)
+    assert np.array_equal(sk, np.sort(keys))
+    assert np.array_equal(keys[si], sk)          # pairing intact
+    assert np.array_equal(np.sort(si), idx)      # payload is a permutation
+
+
+def test_multiple_payload_operands_move_together():
+    rng = np.random.default_rng(0)
+    n = 2048
+    keys = rng.integers(0, 2**32 - 1, n, dtype=np.uint32)
+    p1 = np.arange(n, dtype=np.int32)
+    p2 = (np.arange(n, dtype=np.int32) * 7 + 3)
+    sk, (s1, s2) = bitonic_sort(
+        jnp.asarray(keys), (jnp.asarray(p1), jnp.asarray(p2)),
+        tile_rows=8, interpret=True,
+    )
+    s1, s2 = np.asarray(s1), np.asarray(s2)
+    assert np.array_equal(s2, s1 * 7 + 3)        # rows moved as units
+
+
+def test_all_equal_and_tiny_inputs():
+    for n in (1, 2, 7):
+        keys = np.full(n, 42, np.uint32)
+        sk, (si,) = bitonic_sort(
+            jnp.asarray(keys), (jnp.asarray(np.arange(n, dtype=np.int32)),),
+            tile_rows=8, interpret=True,
+        )
+        assert np.array_equal(np.asarray(sk), keys)
+        assert np.array_equal(np.sort(np.asarray(si)), np.arange(n))
+
+
+def test_engine_folded_keys_never_hit_the_pad_sentinel():
+    """The engine's "bitonic" mode is safe from the documented sentinel
+    caveat BY CONSTRUCTION: a valid row's folded key is h1 >> 1 (top bit
+    clear, < 0x80000000), so only INVALID rows — whose payloads are dead
+    downstream — can carry 0xFFFFFFFF.  Pin the construction."""
+    from locust_tpu.core import bytes_ops
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.ops.process_stage import _folded_key
+
+    words = [b"a", b"bb", b"ccc", b"", b"dddd", b""]
+    keys = jnp.asarray(bytes_ops.strings_to_rows(words, 8))
+    valid = jnp.asarray([bool(w) for w in words])
+    batch = KVBatch.from_bytes(keys, jnp.arange(len(words)), valid)
+    folded = np.asarray(_folded_key(batch))
+    assert (folded[np.asarray(valid)] < 0x80000000).all()
+    assert (folded[~np.asarray(valid)] == 0xFFFFFFFF).all()
+
+
+def test_bad_dtype_rejected():
+    with pytest.raises(TypeError, match="uint32"):
+        bitonic_sort(jnp.zeros(16, jnp.int32), (), interpret=True)
