@@ -56,6 +56,59 @@ if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):
         f"(int32 min sublane tile), got {BITONIC_TILE_ROWS}"
     )
 
+# Cap on compare-exchange substages statically unrolled into ONE Pallas
+# launch.  Unlimited fusion (the round-4 first cut) produced a ~120-substage
+# kernel whose Mosaic compile crashed axon's remote tpu_compile_helper
+# (HTTP 500, measured on v5e 2026-07-31); capping trades extra HBM
+# round-trips for a compilable kernel.  0 = unlimited.
+BITONIC_MAX_FUSED: int = int(_os.environ.get("LOCUST_BITONIC_MAX_FUSED", 0))
+if BITONIC_MAX_FUSED < 0:
+    raise ValueError(
+        f"LOCUST_BITONIC_MAX_FUSED must be >= 0, got {BITONIC_MAX_FUSED}"
+    )
+
+
+def _pack_local_stages(specs, max_fused):
+    """Split/merge tile-local stage specs ``(s, t_hi, t_lo)`` into launches
+    of at most ``max_fused`` substages each (greedy, order-preserving;
+    stages split mid-run when needed)."""
+    launches, cur, cnt = [], [], 0
+    for s, t_hi, t_lo in specs:
+        t = t_hi
+        while t >= t_lo:
+            if cnt == max_fused:
+                launches.append(tuple(cur))
+                cur, cnt = [], 0
+            take = min(max_fused - cnt, t - t_lo + 1)
+            cur.append((s, t, t - take + 1))
+            cnt += take
+            t -= take
+    if cur:
+        launches.append(tuple(cur))
+    return launches
+
+
+def bitonic_schedule(kbits: int, m: int, max_fused: int | None = None):
+    """HBM-pass schedule of the Pallas bitonic sort for ``n = 2^kbits``
+    elements with tile ``2^m``: a list of ``("local", ((s, t_hi, t_lo), ...))``
+    fused-kernel launches and ``("cross", s, t)`` single XLA passes, in
+    execution order.  The ONE place the launch structure is decided —
+    ops/pallas/sort.py executes it and utils/roofline.py counts it, so the
+    modeled pass count can't drift from what the kernel actually does."""
+    mf = BITONIC_MAX_FUSED if max_fused is None else max_fused
+    if mf <= 0:
+        mf = 1 << 30
+    sched = []
+    local1 = [(s, s, 1) for s in range(1, min(kbits, m) + 1)]
+    for ch in _pack_local_stages(local1, mf):
+        sched.append(("local", ch))
+    for s in range(m + 1, kbits + 1):
+        for t in range(s, m, -1):
+            sched.append(("cross", s, t))
+        for ch in _pack_local_stages([(s, m, 1)], mf):
+            sched.append(("local", ch))
+    return sched
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:

@@ -28,7 +28,10 @@ HBM round-trips: 1 + sum_{s=m+1..k} (s - m + 1) where 2^m = tile
 stock network — the "hand-managed VMEM" formulation of the one-pass
 rank/cumsum idea that made the pure-XLA radix attempt lose
 (ops/radix_sort.py: its per-pass gathers go to HBM; here they stay in
-VMEM).
+VMEM).  That count assumes unlimited fusion; when BITONIC_MAX_FUSED
+caps the substages per launch (the Mosaic compile-size mitigation),
+the true count is ``len(config.bitonic_schedule(k, m))`` — the shared
+launch plan both this kernel and utils/roofline.py consume.
 
 The engine-facing mode ("bitonic", config.SORT_MODES) sorts the folded
 31-bit-hash+validity key (process_stage._folded_key, same collision
@@ -46,7 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from locust_tpu.config import BITONIC_TILE_ROWS
+from locust_tpu.config import BITONIC_TILE_ROWS, bitonic_schedule
 
 # Default tile: 2^15 elements = 256 rows x 128 lanes.  Working set per
 # operand = 128KB; key + 9 payload operands (key_width 32) = 1.25MB of
@@ -66,20 +69,25 @@ def _ilog2(n: int) -> int:
     return b
 
 
-def _compare_exchange(arrs, pv, keep_min):
+def _compare_exchange(arrs, pv, keep_min_i):
     """One compare-exchange: arrs[0] is the key; every operand takes its
     partner's value where the key decision says so.  Ties never swap, so
-    the two partners always agree."""
+    the two partners always agree.  ``keep_min_i`` is int32 0/1 and the
+    lt/gt outcomes are widened to int32 before the select: a select whose
+    OPERANDS are bools lowers to ``arith.trunci i8 -> i1``, which v5e
+    Mosaic rejects ("Unsupported target bitwidth for truncation",
+    measured on-hardware 2026-07-31) — masks may be i1, data may not."""
     key, pkey = arrs[0], pv[0]
-    take = jnp.where(
-        keep_min, pkey < key, pkey > key
-    )
+    lt = (pkey < key).astype(jnp.int32)
+    gt = (pkey > key).astype(jnp.int32)
+    take = jnp.where(keep_min_i != 0, lt, gt) != 0
     return [jnp.where(take, p, a) for a, p in zip(arrs, pv)]
 
 
 def _local_stages_kernel(*refs, stages, tile_rows, n_ops):
-    """Run ``stages`` = ((s, t_hi), ...) with every substage t_hi..1
-    tile-local in VMEM.  refs = n_ops inputs then n_ops outputs (aliased)."""
+    """Run ``stages`` = ((s, t_hi, t_lo), ...) with every substage
+    t_hi..t_lo tile-local in VMEM.  refs = n_ops inputs then n_ops
+    outputs (aliased)."""
     ins, outs = refs[:n_ops], refs[n_ops:]
     arrs = [r[:] for r in ins]
     base = pl.program_id(0) * tile_rows * _LANES
@@ -87,12 +95,14 @@ def _local_stages_kernel(*refs, stages, tile_rows, n_ops):
     lane = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, _LANES), 1)
     gidx = base + row * _LANES + lane
 
-    for s, t_hi in stages:
-        asc = ((gidx >> s) & 1) == 0
-        for t in range(t_hi, 0, -1):
+    for s, t_hi, t_lo in stages:
+        asc_i = ((gidx >> s) & 1) ^ 1  # int32 1 = ascending block
+        for t in range(t_hi, t_lo - 1, -1):
             d = 1 << (t - 1)
-            is_lower = (gidx & d) == 0
-            keep_min = asc == is_lower
+            # int32 throughout (no i1==i1 compares, no bool-operand
+            # selects — see _compare_exchange for the Mosaic constraint).
+            is_lower_i = ((gidx & d) == 0).astype(jnp.int32)
+            keep_min_i = 1 - (asc_i ^ is_lower_i)
             if d < _LANES:
                 # Lane-dim exchange: partner lane = lane ^ d.  l + d keeps
                 # bit d set iff it was clear, so the two rolls cover both
@@ -116,7 +126,7 @@ def _local_stages_kernel(*refs, stages, tile_rows, n_ops):
                     ).reshape(tile_rows, _LANES)
 
                 pv = [swap(a) for a in arrs]
-            arrs = _compare_exchange(arrs, pv, keep_min)
+            arrs = _compare_exchange(arrs, pv, keep_min_i)
 
     for o, a in zip(outs, arrs):
         o[:] = a
@@ -192,6 +202,7 @@ def bitonic_sort(
     payloads: tuple[jax.Array, ...] = (),
     tile_rows: int = TILE_ROWS,
     interpret: bool = False,
+    max_fused: int | None = None,
 ) -> tuple[jax.Array, tuple[jax.Array, ...]]:
     """Ascending sort of a uint32 ``key`` [n]; ``payloads`` ride along.
 
@@ -233,16 +244,15 @@ def bitonic_sort(
     arrs = [key_p.reshape(rows, _LANES)] + [
         p.reshape(rows, _LANES) for p in pay_p
     ]
-    # Stages 1..m: every substage tile-local -> ONE kernel launch.
-    arrs = _run_local(
-        arrs, [(s, s) for s in range(1, min(kbits, m) + 1)], tr, interpret
-    )
-    # Stages m+1..k: cross passes down to the tile boundary, then one
-    # fused local launch for the in-tile tail.
-    for s in range(m + 1, kbits + 1):
-        for t in range(s, m, -1):
-            arrs = _run_cross(arrs, s, t)
-        arrs = _run_local(arrs, [(s, m)], tr, interpret)
+    # Execute the shared launch plan (config.bitonic_schedule): fused
+    # VMEM launches for tile-local substage runs (capped at
+    # BITONIC_MAX_FUSED substages each — unlimited fusion crashed axon's
+    # Mosaic remote compile), single XLA passes for cross-tile substages.
+    for step in bitonic_schedule(kbits, m, max_fused):
+        if step[0] == "local":
+            arrs = _run_local(arrs, step[1], tr, interpret)
+        else:
+            arrs = _run_cross(arrs, step[1], step[2])
 
     out_key = arrs[0].reshape(-1)[:n]
     out_pay = tuple(

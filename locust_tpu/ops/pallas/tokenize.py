@@ -37,10 +37,12 @@ def _tokenize_kernel(x_ref, keys_ref, valid_ref, ovf_ref, *, emits, key_w, width
     xi = x.astype(jnp.int32)
 
     # Delimiter classification, statically unrolled over the delimiter set
-    # (reference delimiters, main.cu:138, + NUL pad + CR/LF).
-    is_delim = x == 0
+    # (reference delimiters, main.cu:138, + NUL pad + CR/LF).  Compare on
+    # the int32 widening: v5e Mosaic rejects i8 vector compares
+    # ("Target does not support this comparison", measured on-hardware).
+    is_delim = xi == 0
     for c in DELIMITERS + b"\n\r":
-        is_delim = is_delim | (x == c)
+        is_delim = is_delim | (xi == c)
     in_tok = ~is_delim
 
     zeros_col = jnp.zeros((x.shape[0], 1), dtype=jnp.bool_)

@@ -89,11 +89,15 @@ def sort_pass_count(n_rows: int, mode: str = "hash") -> int:
         return _RADIX_PASSES
     k = math.ceil(math.log2(n_rows))
     if mode == "bitonic":
-        # HBM round-trips of the Pallas tiled network: one fused launch
-        # for stages 1..m, then per outer stage its cross passes + one
-        # fused tail (ops/pallas/sort.py module docstring).
+        # HBM round-trips of the Pallas tiled network = entries in the
+        # SAME launch plan the kernel executes (config.bitonic_schedule:
+        # each fused local launch and each cross pass streams every
+        # operand once) — counting a shared plan instead of a formula
+        # keeps the model honest when BITONIC_MAX_FUSED splits launches.
+        from locust_tpu.config import bitonic_schedule
+
         m = min(k, _bitonic_tile_bits())
-        return 1 + sum(s - m + 1 for s in range(m + 1, k + 1))
+        return len(bitonic_schedule(k, m))
     return k * (k + 1) // 2
 
 

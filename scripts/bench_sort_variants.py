@@ -227,9 +227,19 @@ def main() -> int:
             )
     force = bool(os.environ.get("LOCUST_ARTIFACT_FORCE"))
     for name, fn in chosen:
-        c, ms = timeit(fn, lanes, values, valid)
-        results[name] = {"compile_s": round(c, 1), "run_ms": round(ms, 3)}
-        print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}", flush=True)
+        # Error-isolate per variant: an unsupported-lowering failure on one
+        # (e.g. a Mosaic rejection of the Pallas variant, measured
+        # 2026-07-31: H's compile crash killed B-G's whole window) must
+        # not cost the remaining variants' measurements — the error IS the
+        # evidence row for that variant.
+        try:
+            c, ms = timeit(fn, lanes, values, valid)
+            results[name] = {"compile_s": round(c, 1), "run_ms": round(ms, 3)}
+            print(f"{name}: compile={c:.1f}s run={ms:.2f}ms  N={N}", flush=True)
+        except Exception as e:  # noqa: BLE001 — captured as evidence
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            print(f"{name}: ERROR {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
         # Record after EVERY variant: a window that closes mid-run keeps
         # what it measured (consumers read the latest row of the kind).
         artifacts.record(

@@ -75,3 +75,59 @@ def test_engine_folded_keys_never_hit_the_pad_sentinel():
 def test_bad_dtype_rejected():
     with pytest.raises(TypeError, match="uint32"):
         bitonic_sort(jnp.zeros(16, jnp.int32), (), interpret=True)
+
+
+@pytest.mark.parametrize("max_fused", [1, 3, 16])
+def test_max_fused_chunking_sorts_identically(max_fused):
+    """BITONIC_MAX_FUSED splits the fused launches (the Mosaic
+    compile-size mitigation); every split must sort identically."""
+    rng = np.random.default_rng(7)
+    n = 5000
+    keys = rng.integers(0, 2**32 - 1, n, dtype=np.uint32)
+    idx = np.arange(n, dtype=np.int32)
+    sk, (si,) = bitonic_sort(
+        jnp.asarray(keys), (jnp.asarray(idx),), tile_rows=8,
+        interpret=True, max_fused=max_fused,
+    )
+    sk, si = np.asarray(sk), np.asarray(si)
+    assert np.array_equal(sk, np.sort(keys))
+    assert np.array_equal(keys[si], sk)
+
+
+def test_bitonic_schedule_covers_every_substage_once():
+    """The shared launch plan (config.bitonic_schedule) must enumerate
+    exactly Batcher's network — substages (s, t) for s=1..k, t=s..1, in
+    descending-t order within each stage — for ANY fusion cap."""
+    from locust_tpu.config import bitonic_schedule
+
+    for kbits, m in ((10, 10), (20, 15), (13, 8)):
+        want = [(s, t) for s in range(1, kbits + 1)
+                for t in range(s, 0, -1)]
+        for mf in (0, 1, 5, 64):
+            got = []
+            for step in bitonic_schedule(kbits, m, mf):
+                if step[0] == "cross":
+                    got.append((step[1], step[2]))
+                else:
+                    for s, t_hi, t_lo in step[1]:
+                        got.extend((s, t) for t in range(t_hi, t_lo - 1, -1))
+            assert got == want, (kbits, m, mf)
+            if mf:
+                for step in bitonic_schedule(kbits, m, mf):
+                    if step[0] == "local":
+                        assert sum(t_hi - t_lo + 1
+                                   for _, t_hi, t_lo in step[1]) <= mf
+
+
+def test_roofline_counts_the_shared_schedule():
+    """utils/roofline.sort_pass_count('bitonic') must equal the length of
+    the plan the kernel executes (single source of truth)."""
+    from locust_tpu.config import BITONIC_TILE_ROWS, bitonic_schedule
+    from locust_tpu.utils import roofline
+
+    n = 720_896
+    k = int(np.ceil(np.log2(n)))
+    m = min(k, (BITONIC_TILE_ROWS * 128).bit_length() - 1)
+    assert roofline.sort_pass_count(n, "bitonic") == len(
+        bitonic_schedule(k, m)
+    )
