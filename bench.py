@@ -36,14 +36,27 @@ import threading
 import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
 # Machine-local persistent compilation cache: orchestrator retries and
 # repeat invocations in one environment reuse compiled executables.  NOT
 # the repo-committed directory any more — committed entries were CPU AOT
 # executables whose machine features need not match the host running the
 # bench (XLA loads them with a SIGILL-risk warning; the axon TPU backend
 # never serializes executables, so cross-machine pre-seeding bought
-# nothing and risked crashing the driver's CPU fallback).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+# nothing and risked crashing the driver's CPU fallback) — and keyed by
+# the host CPU's feature flags, because /tmp itself is not guaranteed to
+# be machine-stable across driver sessions (observed 2026-07-31: stale
+# foreign AOT entries in /tmp drew the same SIGILL-risk warnings).
+# Guarded: config.py validates LOCUST_* env vars at import, and an
+# exception HERE (before main()'s watchdog exists) would break the
+# one-JSON-line contract — on failure, skip the persistent cache and let
+# main()'s guarded import surface the error as the JSON error line.
+try:
+    from locust_tpu.config import machine_cache_dir
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
+except Exception:  # noqa: BLE001 - no cache beats no JSON line
+    pass
 
 import numpy as np
 
@@ -669,12 +682,15 @@ def main() -> int:
     watchdog.daemon = True
     watchdog.start()
 
-    from locust_tpu.backend import select_backend
-
     mode = os.environ.get("LOCUST_BENCH_BACKEND", "auto")
     probe_timeout = float(os.environ.get("LOCUST_BENCH_PROBE_TIMEOUT", 180))
     probe_retries = int(os.environ.get("LOCUST_BENCH_PROBE_RETRIES", 3))
     try:
+        # Import inside the guard: locust_tpu.config validates LOCUST_*
+        # env vars at import and raises ValueError on a malformed one —
+        # that must become the JSON error line, not a bare traceback.
+        from locust_tpu.backend import select_backend
+
         backend = select_backend(
             mode, probe_timeout_s=probe_timeout, retries=probe_retries
         )

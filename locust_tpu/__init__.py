@@ -12,13 +12,31 @@ rebuilds, layer by layer.
 
 __version__ = "0.1.0"
 
-# Deliberately light: heavy modules (engine, apps, parallel) import
-# lazily from their own paths so `python -m locust_tpu --help` stays fast.
+# Deliberately light — and jax-free: entrypoints must be able to read
+# config (e.g. config.machine_cache_dir for JAX_COMPILATION_CACHE_DIR)
+# BEFORE their first `import jax`, since jax snapshots env vars at import.
+# The two jax-heavy re-exports resolve lazily (PEP 562).
 from locust_tpu.config import (  # noqa: F401
     DEFAULT_CONFIG,
     DELIMITERS,
     SORT_MODES,
     EngineConfig,
 )
-from locust_tpu.core.kv import KVBatch  # noqa: F401
-from locust_tpu.io.loader import StreamingCorpus  # noqa: F401
+
+_LAZY = {
+    "KVBatch": ("locust_tpu.core.kv", "KVBatch"),
+    "StreamingCorpus": ("locust_tpu.io.loader", "StreamingCorpus"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'locust_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -49,6 +49,32 @@ FULL_DELIMITERS: bytes = DELIMITERS + TOKEN_BOUNDARY_EXTRA
 # knee.
 import os as _os
 
+
+def machine_cache_dir(tag: str = "") -> str:
+    """A /tmp jax compilation-cache dir keyed to THIS machine's CPU.
+
+    The persistent cache stores CPU AOT executables compiled for the exact
+    host feature set; the driver/bench/sweep processes can run on hosts
+    with different CPUs across sessions, and XLA loading a foreign entry
+    warns about (and risks) SIGILL.  Keying the directory by the host's
+    cpuinfo flags makes a foreign machine miss instead of loading a
+    mismatched executable.  jax-free so every entrypoint can call it
+    before its first ``import jax``.
+    """
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            info = f.read()
+        key = next(
+            (ln for ln in info.splitlines() if ln.startswith("flags")), info
+        )
+    except OSError:  # pragma: no cover - non-Linux fallback
+        key = " ".join(_os.uname())
+    h = hashlib.sha1(key.encode()).hexdigest()[:10]
+    return f"/tmp/jax_comp_cache_{h}{tag}"
+
+
 BITONIC_TILE_ROWS: int = int(_os.environ.get("LOCUST_BITONIC_TILE_ROWS", 256))
 if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):
     raise ValueError(
@@ -60,8 +86,13 @@ if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):
 # launch.  Unlimited fusion (the round-4 first cut) produced a ~120-substage
 # kernel whose Mosaic compile crashed axon's remote tpu_compile_helper
 # (HTTP 500, measured on v5e 2026-07-31); capping trades extra HBM
-# round-trips for a compilable kernel.  0 = unlimited.
-BITONIC_MAX_FUSED: int = int(_os.environ.get("LOCUST_BITONIC_MAX_FUSED", 0))
+# round-trips for a compilable kernel.  0 = unlimited.  The DEFAULT is
+# capped (32: ~4 launches for the 120-substage first stage block) so the
+# next hardware attempt runs the mitigation, not the known-crashing
+# schedule; scripts/tpu_checks.py's bitonic_fused_ab ladder measures
+# unlimited fusion alongside, so the cap can be raised the moment
+# hardware shows the int32-mask rewrite alone fixed the Mosaic crash.
+BITONIC_MAX_FUSED: int = int(_os.environ.get("LOCUST_BITONIC_MAX_FUSED", 32))
 if BITONIC_MAX_FUSED < 0:
     raise ValueError(
         f"LOCUST_BITONIC_MAX_FUSED must be >= 0, got {BITONIC_MAX_FUSED}"

@@ -14,9 +14,11 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 
 def corpus_lines(n_vocab: int, total_tokens: int, seed: int = 0) -> list[bytes]:

@@ -1,0 +1,187 @@
+"""Detached TPU-window farming loop (round 4+).
+
+The axon tunnel flaps; evidence only accumulates while a window is open
+(CLAUDE.md).  This loop probes on an interval and, whenever the tunnel is
+up, captures in strict value order:
+
+  1. a fresh headline bench (``python bench.py`` — evidence-tuned config,
+     appends a ``kind: bench`` row) unless one landed within the last hour
+  2. the full decision sweep (``scripts/tpu_opportunistic.py``: sort
+     variants, Pallas check battery, engine sort-mode/block/pallas A/Bs,
+     stage parity, caps A/Bs) — includes the bitonic kernel verdict
+  3. the 512MB bounded-RSS streaming phase, once per session
+  4. auto-commits ``artifacts/tpu_runs.jsonl`` (pathspec-only commit, so
+     it cannot sweep up unrelated working-tree edits)
+
+Yields to any already-running bench/sweep process (e.g. the driver's
+end-of-round bench) and self-expires at the deadline so it can never
+collide with the next round's loop.
+
+Run detached:  nohup python scripts/farm_loop.py --hours 10 \
+                   >> /tmp/locust_farm.log 2>&1 &
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(REPO, "artifacts", "tpu_runs.jsonl")
+
+
+def log(msg: str) -> None:
+    print(f"[farm {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def other_jobs_running() -> bool:
+    """True if a bench/sweep process (not this loop's own child) is live —
+    the driver's end-of-round bench must win the window, not fight us."""
+    try:
+        out = subprocess.run(
+            ["pgrep", "-af", "bench.py|tpu_opportunistic|opp_resume"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+    except Exception:
+        return False
+    me = os.getpid()
+    for line in out.splitlines():
+        pid = int(line.split()[0])
+        if pid != me and "farm_loop" not in line:
+            return True
+    return False
+
+
+def probe() -> bool:
+    """Subprocess-isolated tunnel probe: a wedged tunnel hangs any python
+    that touches a jax backend (CLAUDE.md), so the probe must be killable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from locust_tpu.backend import probe_tpu;"
+             "ok, d = probe_tpu(timeout_s=90, retries=1);"
+             "import sys; sys.exit(0 if ok else 3)"],
+            cwd=REPO, timeout=150, capture_output=True, text=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def ledger_rows() -> list[dict]:
+    rows = []
+    try:
+        with open(LEDGER) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return rows
+
+
+def latest_ts(kind: str, backend: str = "tpu") -> float:
+    ts = 0.0
+    for r in ledger_rows():
+        if r.get("kind") == kind and r.get("backend") == backend:
+            ts = max(ts, float(r.get("ts", 0)))
+    return ts
+
+
+def run(cmd: list[str], timeout: float, env: dict | None = None) -> int:
+    log(f"run: {' '.join(cmd)} (timeout {timeout:.0f}s)")
+    try:
+        r = subprocess.run(
+            cmd, cwd=REPO, timeout=timeout, env=env,
+            stdout=subprocess.DEVNULL, stderr=sys.stderr,
+        )
+        log(f"rc={r.returncode}")
+        return r.returncode
+    except subprocess.TimeoutExpired:
+        log("TIMEOUT")
+        return 124
+    except Exception as e:  # noqa: BLE001 - the loop must survive anything
+        log(f"error: {type(e).__name__}: {e}")
+        return 1
+
+
+def commit_ledger() -> None:
+    """Commit ONLY the evidence ledger; retry briefly on index-lock races
+    with the interactive session's own commits."""
+    diff = subprocess.run(
+        ["git", "diff", "--quiet", "HEAD", "--", LEDGER], cwd=REPO
+    )
+    if diff.returncode == 0:
+        untracked = subprocess.run(
+            ["git", "ls-files", "--error-unmatch", LEDGER],
+            cwd=REPO, capture_output=True,
+        )
+        if untracked.returncode == 0:
+            return  # tracked and unchanged
+    for _ in range(5):
+        add = subprocess.run(["git", "add", LEDGER], cwd=REPO,
+                             capture_output=True, text=True)
+        c = subprocess.run(
+            ["git", "commit", "-m",
+             "Ledger: TPU window evidence rows (farm loop)", "--", LEDGER],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        if c.returncode == 0:
+            log(f"committed ledger: {c.stdout.strip().splitlines()[0]}")
+            return
+        if "lock" in (c.stderr + add.stderr).lower():
+            time.sleep(3)
+            continue
+        log(f"commit skipped: {(c.stdout + c.stderr).strip()[:200]}")
+        return
+
+
+def harvest_window() -> None:
+    """One open window: bench -> sweep -> (stream) -> commit."""
+    # 1. Headline bench, unless a TPU bench row landed within the hour.
+    if time.time() - latest_ts("bench") > 3600:
+        run([sys.executable, "bench.py"], timeout=1300)
+        commit_ledger()
+    # 2. Full decision sweep (bitonic verdict, sort-mode/block/pallas
+    #    A/Bs, Pallas check battery, stage parity, caps A/Bs).  The
+    #    stream phase rides along until a stream_scale row has actually
+    #    landed in the ledger — derived from the ledger each window, so a
+    #    sweep that dies before the stream phase retries it next window.
+    env = dict(os.environ)
+    if not latest_ts("stream_scale"):
+        env["LOCUST_OPP_STREAM_MB"] = os.environ.get(
+            "LOCUST_FARM_STREAM_MB", "512")
+    run([sys.executable, os.path.join("scripts", "tpu_opportunistic.py")],
+        timeout=2400, env=env)
+    commit_ledger()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=10.0,
+                    help="self-expire after this many hours")
+    ap.add_argument("--interval", type=float, default=480.0,
+                    help="seconds between probes")
+    args = ap.parse_args()
+    deadline = time.time() + args.hours * 3600
+    log(f"farming until {time.strftime('%H:%M:%S', time.localtime(deadline))} "
+        f"(probe every {args.interval:.0f}s)")
+    while time.time() < deadline:
+        if other_jobs_running():
+            log("yielding: bench/sweep already running")
+        elif probe():
+            log("tunnel UP — harvesting")
+            harvest_window()
+        else:
+            log("tunnel down")
+        time.sleep(max(10.0, min(args.interval, deadline - time.time())))
+    log("deadline reached; exiting")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,9 +19,12 @@ import resource
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 # Engine sort modes covered by the end-to-end A/B (phase 3).
 # Priority order: a short window should answer the open question first —
@@ -186,8 +189,9 @@ def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
 
     Returns ``(winning block_lines, its staged device blocks)`` so
     phase_pallas_ab skips one full-corpus H2D; only the best-so-far
-    staging is kept alive (losers are dropped as soon as they're beaten,
-    bounding peak HBM at ~2 stagings instead of all three)."""
+    staging is kept alive (losers — and failed sizes — are dropped as
+    soon as they're decided, bounding peak HBM at ~2 stagings instead of
+    all four)."""
     import bench
 
     from locust_tpu.engine import MapReduceEngine
@@ -195,34 +199,57 @@ def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
 
     results = {}
     best_key, best_blocks = None, None
-    for bl in (16384, 32768, 65536):
-        eng = MapReduceEngine(
-            bench.bench_engine_config(bl, sort_mode=sort_mode, **(caps or {}))
-        )
-        blocks = eng.prepare_blocks(rows_ab)
-        blocks.block_until_ready()
-        eng.run_blocks(blocks)  # compile + warm
-        best = float("inf")
-        for _ in range(3):
-            res = eng.run_blocks(blocks)
-            best = min(best, res.times.total_ms / 1e3)
-        results[str(bl)] = {
-            "mb_s": round(corpus_bytes / 1e6 / best, 2),
-            "best_s": round(best, 4),
-        }
+    # 16384 lost decisively in the committed r4 row (54.2 vs 64.0 MB/s at
+    # 65536); the open question is now UPWARD — bigger blocks amortize
+    # dispatch latency (large over the axon tunnel) and per-block fixed
+    # costs, at the price of a bigger per-block sort.  781k bench lines
+    # still fill >=3 blocks at 262144, so padding waste stays honest.
+    sizes = (32768, 65536, 131072, 262144)
+    for bl in sizes:
+        try:
+            eng = MapReduceEngine(
+                bench.bench_engine_config(bl, sort_mode=sort_mode,
+                                          **(caps or {}))
+            )
+            blocks = eng.prepare_blocks(rows_ab)
+            blocks.block_until_ready()
+            eng.run_blocks(blocks)  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                res = eng.run_blocks(blocks)
+                best = min(best, res.times.total_ms / 1e3)
+            results[str(bl)] = {
+                "mb_s": round(corpus_bytes / 1e6 / best, 2),
+                "best_s": round(best, 4),
+            }
+        except Exception as e:  # noqa: BLE001 - the 131072/262144 sizes have
+            # never run on hardware; an OOM/compile failure there must not
+            # discard the measured sizes or kill the later phases (an
+            # errored side has no mb_s and can never be adopted).
+            results[str(bl)] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            blocks = None  # drop the failed size's staging before the next
         print(f"[opp] block_lines={bl}: {results[str(bl)]}", file=sys.stderr)
-        if (
+        if "mb_s" in results[str(bl)] and (
             best_key is None
             or results[str(bl)]["mb_s"] > results[best_key]["mb_s"]
         ):
             best_key, best_blocks = str(bl), blocks
-        else:
+        elif "mb_s" in results[str(bl)]:
             del blocks  # loser's staging: free its HBM before the next
-    artifacts.record(
-        "block_lines_ab",
-        {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
-         "caps": caps, "blocks": results},
-    )
+        # Record after EVERY size: a window that closes mid-phase keeps
+        # what it measured (same incremental rule as phase_sort_mode_ab).
+        artifacts.record(
+            "block_lines_ab",
+            {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
+             "caps": caps, "blocks": dict(results),
+             "partial": bl != sizes[-1]},
+        )
+    if best_key is None:
+        # Every size errored: hand downstream phases the static default
+        # rather than crashing the remaining sweep.
+        print("[opp] all block sizes errored; downstream phases run at "
+              "32768", file=sys.stderr)
+        return 32768, None
     return int(best_key), best_blocks
 
 
@@ -272,6 +299,59 @@ def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
          "block_lines": block_lines, "caps": caps, "pallas": results},
     )
+
+
+def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
+                          block_lines: int, caps=None) -> None:
+    """Per-stage timing at the WINNING headline configuration.
+
+    stage_parity (below) reports the reference's own shapes (700/4463
+    lines at block_lines=1024) for the direct GTX-1060 table comparison;
+    this row instead answers "where does the remaining time go at the
+    shape the headline bench actually runs" — the number that steers the
+    next optimization (sort kernel vs map vs reduce).  Stage boundaries
+    sync (timed_run), so total_ms here OVERSTATES the fused pipeline; the
+    fused number at this exact configuration lives in the same window's
+    block_lines_ab row (same corpus, same caps) — compare against that,
+    not against this row's total.
+    """
+    import bench
+
+    from locust_tpu.engine import MapReduceEngine
+    from locust_tpu.utils import artifacts
+
+    try:
+        eng = MapReduceEngine(
+            bench.bench_engine_config(block_lines, sort_mode=sort_mode,
+                                      **(caps or {}))
+        )
+        eng.timed_run(rows_ab)  # compile + warm
+        best = None
+        for _ in range(3):
+            r = eng.timed_run(rows_ab)
+            if best is None or r.times.total_ms < best.times.total_ms:
+                best = r
+        row = {
+            "corpus_mb": round(corpus_bytes / 1e6, 1),
+            "sort_mode": sort_mode,
+            "block_lines": block_lines,
+            "caps": caps,
+            "map_ms": round(best.times.map_ms, 1),
+            "process_ms": round(best.times.process_ms, 1),
+            "reduce_ms": round(best.times.reduce_ms, 1),
+            "total_ms": round(best.times.total_ms, 1),
+            "distinct": best.num_segments,
+        }
+    except Exception as e:  # noqa: BLE001 - informational phase: a failure
+        # here must not kill stage_parity/emits/key-width/stream behind it
+        row = {
+            "corpus_mb": round(corpus_bytes / 1e6, 1),
+            "sort_mode": sort_mode,
+            "block_lines": block_lines,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }
+    artifacts.record("stage_breakdown_bench_shape", row)
+    print(f"[opp] bench-shape stage breakdown: {row}", file=sys.stderr)
 
 
 def phase_emits_ab(rows_ab, corpus_bytes, key_width: int = 32) -> None:
@@ -443,6 +523,8 @@ def run_phases() -> None:
     )
     phase_pallas_ab(rows_ab, corpus_bytes, sort_mode=winner,
                     block_lines=best_bl, caps=caps, blocks=best_blocks)
+    phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode=winner,
+                          block_lines=best_bl, caps=caps)
     phase_stage_parity()
     phase_emits_ab(rows_ab, corpus_bytes, key_width=kw)
     phase_key_width_ab(rows_ab, corpus_bytes)

@@ -15,8 +15,11 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 def main() -> int:
     from locust_tpu.backend import select_backend
@@ -184,6 +187,48 @@ def main() -> int:
                 "check": "bitonic_tile_ab",
                 "error": f"{type(e).__name__}: {e}"[:400],
             }
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
+
+        # 5. Fusion-cap ladder: the static default is capped at
+        # config.BITONIC_MAX_FUSED because UNLIMITED fusion crashed
+        # Mosaic on 2026-07-31 — but that crash predates the int32-mask
+        # rewrite, so this ladder measures whether the cap is still
+        # needed and what it costs.  Each rung error-isolated: the
+        # known-risky mf=0 compile must not take down the row.
+        from locust_tpu.config import BITONIC_MAX_FUSED
+
+        fused = {str(BITONIC_MAX_FUSED): {
+            "ms": row.get("tiles", {}).get(str(TILE_ROWS), {}).get("ms"),
+            "note": "config default, from bitonic_tile_ab",
+        } if "tiles" in row else {"note": "see bitonic_sort_ab"}}
+        for mf in (128, 0):
+            if mf == BITONIC_MAX_FUSED:
+                continue
+            try:
+                f = jax.jit(functools.partial(
+                    bitonic_sort, max_fused=mf, interpret=False
+                ))
+                t0 = time.perf_counter()
+                sk, (sp,) = f(key, (pay,))
+                jax.block_until_ready(sk)
+                compile_s = time.perf_counter() - t0
+                sk_np, sp_np = np.asarray(sk), np.asarray(sp)
+                if not (
+                    np.array_equal(sk_np, sorted_keys)
+                    and np.array_equal(key_np[sp_np], sk_np)
+                ):
+                    fused[str(mf)] = {"error": "output failed oracle"}
+                    continue
+                ms = best_ms(lambda f=f: f(key, (pay,))[0])
+                fused[str(mf)] = {
+                    "ms": round(ms, 3), "compile_s": round(compile_s, 1),
+                }
+                print(f"[tpu_checks] bitonic max_fused={mf}: {ms:.1f}ms",
+                      file=sys.stderr, flush=True)
+            except Exception as e:  # noqa: BLE001 - record the rung's loss
+                fused[str(mf)] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        row = {"check": "bitonic_fused_ab", "n": n, "fused": fused}
         print(json.dumps(row), flush=True)
         artifacts.record("tpu_check", row)
     return 0

@@ -19,16 +19,20 @@ if "xla_force_host_platform_device_count" not in flags:
 # Keep CPU tests deterministic and quiet.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compile cache: repeat suite runs skip most XLA compiles.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache_cpu")
+# Machine-keyed (config.machine_cache_dir): /tmp can hold stale AOT entries
+# compiled on a different host CPU, which XLA loads with a SIGILL risk.
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from locust_tpu.config import machine_cache_dir as _mcd
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _mcd("_cpu"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # The host environment may inject a remote-TPU PJRT plugin ("axon") into every
 # interpreter via sitecustomize.  jax initializes ALL registered plugins on
 # first backend use even when JAX_PLATFORMS=cpu, so a slow/wedged TPU tunnel
 # would stall pure-CPU tests.  Deregister it for the test process.
-import sys as _sys
-
-_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from locust_tpu.backend import force_cpu as _force_cpu
 
 _force_cpu()

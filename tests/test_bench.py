@@ -293,3 +293,31 @@ def test_error_payload_shape():
     row = bench.error_payload("boom")
     assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}
     assert row["value"] == 0.0
+
+
+def test_bad_config_env_still_emits_one_json_line(tmp_path):
+    """A malformed LOCUST_* env var that locust_tpu.config rejects at
+    import must surface as the single JSON error line, not a bare
+    traceback — config import happens inside main()'s guard (and the
+    module-level cache-dir import is its own no-cache-beats-no-JSON
+    try).  Real subprocess: the failure mode is import-order-dependent."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH=repo,
+        JAX_PLATFORMS="cpu",
+        LOCUST_BENCH_BACKEND="cpu",
+        LOCUST_BITONIC_MAX_FUSED="-1",
+        LOCUST_ARTIFACTS_DIR=str(tmp_path),
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout + out.stderr
+    row = json.loads(lines[0])
+    assert "LOCUST_BITONIC_MAX_FUSED" in row["error"]
+    assert out.returncode == 1

@@ -31,6 +31,8 @@ REPO = Path(__file__).resolve().parent.parent
 # base lines must be the worker's own (tests/ is importable).
 from multiprocess_worker import BASE_LINES as BASE  # noqa: E402
 
+from locust_tpu.config import machine_cache_dir  # noqa: E402
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -50,7 +52,7 @@ def _run_workers(tmp_path, mode, extra_args=(), n_procs=2):
             "PYTHONPATH": str(REPO),
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-            "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_comp_cache_cpu",
+            "JAX_COMPILATION_CACHE_DIR": machine_cache_dir("_cpu"),
         }
     )
     # Worker output goes to FILES, not pipes: interdependent collective
@@ -237,7 +239,7 @@ def test_cli_pod_launch(tmp_path):
             "PYTHONPATH": str(REPO),
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-            "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_comp_cache_cpu",
+            "JAX_COMPILATION_CACHE_DIR": machine_cache_dir("_cpu"),
         }
     )
     outs = [tmp_path / f"cli{pid}.out" for pid in (0, 1)]
