@@ -36,20 +36,32 @@ def log(msg: str) -> None:
     print(f"[farm {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+JOB_SCRIPTS = ("bench.py", "tpu_opportunistic.py", "opp_resume.py")
+
+
 def other_jobs_running() -> bool:
-    """True if a bench/sweep process (not this loop's own child) is live —
-    the driver's end-of-round bench must win the window, not fight us."""
-    try:
-        out = subprocess.run(
-            ["pgrep", "-af", "bench.py|tpu_opportunistic|opp_resume"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout
-    except Exception:
-        return False
+    """True if a bench/sweep PYTHON process is live — the driver's
+    end-of-round bench must win the window, not fight us.
+
+    Reads /proc argv directly instead of ``pgrep -f``: a full-cmdline
+    regex also matches unrelated processes that merely MENTION a script
+    name somewhere in a long argument (observed: the driver harness's own
+    command line), which would make this loop yield forever."""
     me = os.getpid()
-    for line in out.splitlines():
-        pid = int(line.split()[0])
-        if pid != me and "farm_loop" not in line:
+    for pid_dir in os.listdir("/proc"):
+        if not pid_dir.isdigit() or int(pid_dir) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_dir}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if not argv or b"python" not in os.path.basename(argv[0]):
+            continue
+        if any(
+            os.path.basename(a.decode(errors="replace")) in JOB_SCRIPTS
+            for a in argv[1:3]
+        ):
             return True
     return False
 
