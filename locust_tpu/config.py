@@ -25,7 +25,9 @@ DELIMITERS: bytes = b" ,.-;:'()\"\t"
 # The single source of truth for Process-stage sort strategies:
 # EngineConfig validation, the CLI --sort-mode choices, and
 # ops.process_stage.sort_and_compact dispatch all key off this.
-SORT_MODES = ("hash", "hashp", "hashp2", "hash1", "radix", "bitonic", "lex")
+SORT_MODES = (
+    "hash", "hashp", "hashp2", "hashp1", "hash1", "radix", "bitonic", "lex"
+)
 
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.

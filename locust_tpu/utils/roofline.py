@@ -57,6 +57,7 @@ _MODE_OPERANDS = {
     "hash": (4, 0, True),      # (invalid, h1, h2, idx), then row gather
     "hashp": (3, None, False),  # 3 hash keys + row payload
     "hashp2": (2, None, False),  # folded hash + h2 tiebreak + row payload
+    "hashp1": (1, None, False),  # folded hash only + row payload
     "hash1": (2, 0, True),     # (folded key, idx), then row gather
     "radix": (2, 0, True),     # folded key + rank arrays, then row gather
     "bitonic": (1, None, False),  # folded key + row payload, VMEM tiles

@@ -27,10 +27,12 @@ from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 # Engine sort modes covered by the end-to-end A/B (phase 3).
-# Priority order: a short window should answer the open question first —
-# the Pallas bitonic kernel vs the measured payload-carry incumbent
-# (hashp, 67.4ms on-hardware) — before re-timing the also-rans.
-AB_SORT_MODES = ("bitonic", "hashp", "hashp2", "hash", "hash1", "radix")
+# Priority order: a short window should answer the open questions first —
+# the Pallas bitonic kernel (Mosaic verdict) and the new minimum-traffic
+# hashp1 vs the measured winner hashp2 (57.6 MB/s on-hardware) — before
+# re-timing the also-rans.
+AB_SORT_MODES = ("bitonic", "hashp1", "hashp2", "hashp", "hash", "hash1",
+                 "radix")
 
 
 def tunnel_gate() -> bool:

@@ -13,7 +13,7 @@ import jax
 
 from helpers import py_wordcount
 
-from locust_tpu.config import EngineConfig
+from locust_tpu.config import SORT_MODES, EngineConfig
 from locust_tpu.core import bytes_ops
 from locust_tpu.engine import MapReduceEngine
 
@@ -25,7 +25,7 @@ def make_case(seed: int):
         line_width=int(rng.choice([32, 64, 100, 128])),
         key_width=int(rng.choice([8, 16, 32])),
         emits_per_line=int(rng.choice([2, 4, 8, 20])),
-        sort_mode=str(rng.choice(["hash", "hashp", "hashp2", "hash1", "radix", "bitonic", "lex"])),
+        sort_mode=str(rng.choice(list(SORT_MODES))),
         table_size=4096,
     )
     n_vocab = int(rng.choice([3, 40, 800]))

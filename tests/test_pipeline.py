@@ -12,7 +12,7 @@ import pytest
 
 from helpers import py_wordcount, strtok_tokens
 
-from locust_tpu.config import EngineConfig
+from locust_tpu.config import SORT_MODES, EngineConfig
 from locust_tpu.core import bytes_ops
 from locust_tpu.engine import MapReduceEngine
 from locust_tpu.ops import map_stage, process_stage, reduce_stage
@@ -289,7 +289,7 @@ def test_engine_checkpoint_fingerprint_mismatch_starts_fresh(tmp_path):
     )
 
 
-@pytest.mark.parametrize("mode", ["hash", "hashp", "hashp2", "hash1", "radix", "bitonic", "lex"])
+@pytest.mark.parametrize("mode", list(SORT_MODES))
 def test_engine_oracle_exact_across_sort_modes(mode):
     """Every Process-stage sort strategy must produce the identical table
     (VERDICT r2 missing #2: hash1/radix are the optimized-sort attempts)."""

@@ -26,9 +26,11 @@ def test_mode_row_bytes_ordering():
     re-deriving every constant."""
     lanes = 4  # key_width 16
     per_pass = {m: roofline.mode_row_bytes(m, lanes) for m in
-                ("hash", "hashp", "hashp2", "hash1", "lex")}
-    # hashp2 drops one key operand vs hashp.
+                ("hash", "hashp", "hashp2", "hashp1", "hash1", "lex")}
+    # Each step down the payload-carry ladder drops one key operand.
     assert per_pass["hashp2"][0] == per_pass["hashp"][0] - 4
+    assert per_pass["hashp1"][0] == per_pass["hashp2"][0] - 4
+    assert per_pass["hashp1"][1] == 0  # no gather
     # hash1 sorts the narrowest operand set of the gather modes.
     assert per_pass["hash1"][0] < per_pass["hash"][0]
     # Gather modes pay the row move once; payload modes don't.
