@@ -155,6 +155,24 @@ def variant_h(lanes, values, valid):
     return jnp.sum(pays[0]) + jnp.sum(pays[-1].astype(jnp.uint32))
 
 
+def variant_i(lanes, values, valid):
+    """1 sort key + payload-carry: variant D's folded 31-bit key with
+    variant C's payload carriage and no tiebreaker — the minimum-traffic
+    lax.sort formulation, exposed by the engine as sort_mode="hashp1"
+    (one fewer key operand than G; collision story identical to D)."""
+    import jax
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    h1, _ = packing.hash_pair(lanes)
+    key = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    out = jax.lax.sort(
+        (key, *(lanes[:, i] for i in range(L)), values), num_keys=1
+    )
+    return jnp.sum(out[1]) + jnp.sum(out[-1].astype(jnp.uint32))
+
+
 VARIANTS = [
     ("A_lex9", variant_a),
     ("B_hash3_gather", variant_b),
@@ -164,6 +182,7 @@ VARIANTS = [
     ("F_radix6x6", variant_f),
     ("G_hash2_payload", variant_g),
     ("H_bitonic_pallas", variant_h),
+    ("I_hash1_payload", variant_i),
 ]
 
 
