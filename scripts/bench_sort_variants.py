@@ -173,6 +173,47 @@ def variant_i(lanes, values, valid):
     return jnp.sum(out[1]) + jnp.sum(out[-1].astype(jnp.uint32))
 
 
+def variant_j(lanes, values, valid):
+    """SORT-FREE aggregation probe: scatter-add into a hash-bucket table.
+
+    The engine's Process+Reduce exists to produce per-key totals; a hash
+    table does that in O(n) single-pass traffic instead of O(n log^2 n)
+    sort passes — IF the backend's scatter-with-duplicate-indices is not
+    serialized.  This variant times the three primitives such an engine
+    mode would be built from, at the real shape:
+
+      * scatter-add of values into table_size buckets (duplicate indices),
+      * scatter-max claiming a representative key per bucket,
+      * per-row gather-back + compare (the collision-verify pass that
+        routes mismatched rows to a tiny sort-based fallback).
+
+    It does NOT produce the engine's exact output (collided rows would
+    need the fallback pass); it measures whether the primitives leave the
+    sort's measured 0.58s/33.6MB far enough behind to justify building
+    that mode.  Recorded like every variant; adoption only ever follows
+    an engine-level A/B.
+    """
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    T = 65536  # resolved_table_size at bench shapes
+    h1, h2 = packing.hash_pair(lanes)
+    folded = jnp.where(valid, h1 >> 1, jnp.uint32(0xFFFFFFFF))
+    bucket = (h1 ^ h2) & jnp.uint32(T - 1)
+    counts = jnp.zeros(T, jnp.int32).at[bucket].add(
+        jnp.where(valid, values, 0), mode="drop"
+    )
+    claimed = jnp.zeros(T, jnp.uint32).at[bucket].max(
+        jnp.where(valid, folded, jnp.uint32(0)), mode="drop"
+    )
+    mismatch = valid & (claimed[bucket] != folded)
+    return (
+        jnp.sum(counts.astype(jnp.uint32))
+        + jnp.sum(mismatch.astype(jnp.uint32))
+    )
+
+
 VARIANTS = [
     ("A_lex9", variant_a),
     ("B_hash3_gather", variant_b),
@@ -183,6 +224,7 @@ VARIANTS = [
     ("G_hash2_payload", variant_g),
     ("H_bitonic_pallas", variant_h),
     ("I_hash1_payload", variant_i),
+    ("J_scatter_agg", variant_j),
 ]
 
 

@@ -40,7 +40,7 @@ def main() -> int:
     # first): H = the new Pallas bitonic kernel, C = the payload-carry
     # incumbent it must beat, then the rest; radix (E/F) last — already
     # measured losers (2.5-3x), only re-timed if the window is generous.
-    env["LOCUST_SORT_VARIANTS"] = "H,I,G,C,B,D,E,F"
+    env["LOCUST_SORT_VARIANTS"] = "H,I,J,G,C,B,D,E,F"
     env["N"] = str(65536 + 32768 * 20)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_sort_variants.py"),
