@@ -98,7 +98,10 @@ _PER_BACKEND = {
     # engine_sort_mode_ab row supersedes this the moment a window lands
     # one (_evidence_tuned_tpu_defaults).
     "tpu": {"block_lines": 32768, "sort_mode": "hashp", "use_pallas": False},
-    "cpu": {"block_lines": 16384, "sort_mode": "hash1", "use_pallas": False},
+    # CPU: the sort-free hash-table fold wins the driver-policy grid
+    # decisively (artifacts/bench_block_cpu_r4.jsonl, 2026-07-31:
+    # hasht@8192 = 7.94 MB/s vs the round-3 default hash1@16384 = 5.14).
+    "cpu": {"block_lines": 8192, "sort_mode": "hasht", "use_pallas": False},
 }
 TIMEOUT_S = float(os.environ.get("LOCUST_BENCH_TIMEOUT", 1200))
 # Wall-clock reserved for the final CPU fallback when the retry loop gives

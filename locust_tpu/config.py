@@ -26,7 +26,8 @@ DELIMITERS: bytes = b" ,.-;:'()\"\t"
 # EngineConfig validation, the CLI --sort-mode choices, and
 # ops.process_stage.sort_and_compact dispatch all key off this.
 SORT_MODES = (
-    "hash", "hashp", "hashp2", "hashp1", "hash1", "radix", "bitonic", "lex"
+    "hash", "hashp", "hashp2", "hashp1", "hash1", "radix", "bitonic", "lex",
+    "hasht",
 )
 
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
@@ -76,6 +77,14 @@ def machine_cache_dir(tag: str = "") -> str:
     h = hashlib.sha1(key.encode()).hexdigest()[:10]
     return f"/tmp/jax_comp_cache_{h}{tag}"
 
+
+# Probe rounds of the sort-free hash-table aggregation (sort_mode="hasht",
+# ops/hash_table.py) before a row falls back to the exact sort path.
+# jax-free HERE so utils/roofline.py can model the pass count without
+# importing the kernel module.
+HASHT_PROBES: int = int(_os.environ.get("LOCUST_HASHT_PROBES", 4))
+if HASHT_PROBES < 1:
+    raise ValueError(f"LOCUST_HASHT_PROBES must be >= 1, got {HASHT_PROBES}")
 
 BITONIC_TILE_ROWS: int = int(_os.environ.get("LOCUST_BITONIC_TILE_ROWS", 256))
 if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):

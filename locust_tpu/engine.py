@@ -154,6 +154,65 @@ class MapReduceEngine:
             )
             return merged, overflow, distinct
 
+        def fold_block_hasht(acc: KVBatch, lines: jax.Array):
+            """Sort-free fold: scatter-aggregate straight into the table.
+
+            ``hash_aggregate`` replaces the sort AND the segment reduce
+            AND the accumulator merge in one O(n) pass (ops/hash_table.py).
+            Three-way exactness ladder on the unresolved-row count (a key
+            that loses every probe round loses them deterministically on
+            EVERY fold, so the middle path is steady-state, not rare):
+
+              0 unresolved           -> the table is the answer;
+              <= RESIDUAL_CAP        -> compact the stragglers into a
+                                        small buffer, sort only that, and
+                                        place them into empty slots
+                                        (place_residual — milliseconds);
+              >  RESIDUAL_CAP        -> the full stock sort fallback
+                                        (correctness anchor; near-capacity
+                                        load only).
+
+            Never wrong, and truncation stays as observable as in the
+            sort modes (each path returns the pre-capacity distinct).
+            """
+            from locust_tpu.ops.hash_table import (
+                RESIDUAL_CAP,
+                hash_aggregate,
+                place_residual,
+            )
+
+            kv, overflow = map_fn(lines, cfg)
+            both = KVBatch.concat(acc, kv)
+            table, used, unresolved = hash_aggregate(both, tsize, combine)
+            n_unres = jnp.sum(unresolved.astype(jnp.int32))
+
+            def fast(_):
+                return table, used
+
+            def small(_):
+                return place_residual(table, used, both, unresolved, combine)
+
+            def full(_):
+                resid = KVBatch(both.key_lanes, both.values, unresolved)
+                return segment_reduce_into(
+                    sort_and_compact(KVBatch.concat(table, resid), "hashp1"),
+                    tsize,
+                    combine,
+                )
+
+            merged, distinct = jax.lax.cond(
+                n_unres == 0,
+                fast,
+                lambda op: jax.lax.cond(
+                    n_unres <= RESIDUAL_CAP, small, full, op
+                ),
+                operand=None,
+            )
+            return merged, overflow, distinct
+
+        if mode == "hasht":
+            fold_block = fold_block_hasht
+
         def scan_blocks(blocks: jax.Array):
             """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.
 
@@ -501,9 +560,12 @@ class MapReduceEngine:
         if os.environ.get("LOCUST_DEBUG_CHECKS"):
             # Opt-in invariant sweep on the result table (the sanitizer
             # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.
+            # "hasht" tables are slot-ordered (valid entries scattered by
+            # hash, not compacted to a prefix) — the layout invariant is
+            # a property of the SORT folds, not of correctness.
             from locust_tpu.utils.checks import validate_batch
 
-            validate_batch(acc, expect_compact=True)
+            validate_batch(acc, expect_compact=self.cfg.sort_mode != "hasht")
         num = int(num_segments)
         truncated = num > acc.size
         if truncated:

@@ -1,0 +1,229 @@
+"""Sort-free Process+Reduce: multi-probe hash-table aggregation.
+
+The reference's Process stage exists to group equal keys so a segment
+pass can total them (thrust sort at reference MapReduce/src/main.cu:414-415,
+94% of its GPU runtime) — but per-key totals do not inherently need a
+sort.  This module aggregates an emit batch directly into a fixed-size
+open-addressed hash table with XLA scatters:
+
+  per probe round (double hashing, ``slot_p = (h1 + p*(h2|1)) % T``):
+    1. rows COMPETE for their slot by scatter-min over a 31-bit folded
+       hash (the winner per slot is deterministic: smallest folded);
+    2. winners whose slot is EMPTY write their full key lanes
+       (same-key writers write identical bytes, so duplicate-index
+       write order cannot matter);
+    3. every unresolved row gathers its slot's stored lanes and compares
+       ALL lanes — a row is resolved only by an exact full-key match, so
+       hash collisions can never merge distinct keys (same invariant as
+       the sort modes' boundary compare, process_stage.py);
+    4. resolved rows scatter-combine their values into the slot
+       (sum/min/max — the same normalized combiners as segment_reduce).
+
+  Rows still unresolved after all rounds (probe exhaustion under high
+  load, or a pathological folded-hash fight) are returned as a mask; the
+  engine routes them through the EXACT stock sort+segment-reduce
+  fallback (engine.py fold path), so the mode degrades to today's
+  behavior rather than to a wrong answer.
+
+Traffic: ~4 rounds x ~11 row-sized gather/scatter sweeps vs the
+incumbent sort's ~21 passes x 6 operands x read+write — roughly 6x less
+HBM movement at the bench shape, IF the backend's duplicate-index
+scatter is not serialized (scripts/bench_sort_variants.py variant J
+measures exactly that primitive; CPU: 19x).
+
+Empty-slot sentinel: lane 0 == 0.  A valid emit's key starts with a
+non-delimiter, non-NUL byte packed big-endian into lane 0, so lane 0 of
+any real key is >= 0x01000000; rows violating this (impossible via the
+tokenizer, but cheap to guard) are simply left to the exact fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from locust_tpu.config import HASHT_PROBES as DEFAULT_PROBES
+from locust_tpu.core import packing
+from locust_tpu.core.kv import KVBatch
+
+# DEFAULT_PROBES (config.HASHT_PROBES, default 4): at the bench load
+# factor (~5.6k distinct in 65,536 slots ≈ 0.09) the expected unresolved
+# fraction after 4 rounds is ~0.09^4 ≈ 7e-5 of KEYS — in practice zero,
+# so the engine's fallback `lax.cond` almost never fires.
+
+_COMBINE_INIT = {"sum": 0, "count": 0, "min": 2**31 - 1, "max": -(2**31)}
+
+
+def hash_aggregate(
+    batch: KVBatch,
+    out_size: int,
+    combine: str = "sum",
+    probes: int = DEFAULT_PROBES,
+) -> tuple[KVBatch, jax.Array, jax.Array]:
+    """Aggregate ``batch`` into an ``out_size``-slot table without sorting.
+
+    Returns ``(table, used_count, unresolved_mask)``:
+
+    * ``table`` — KVBatch of capacity ``out_size``; used slots hold one
+      distinct key each with its combined value (device order is slot
+      order, like the sort modes' hash order — host finalize re-sorts);
+    * ``used_count`` — number of occupied slots == distinct keys
+      resolved (every resolved key occupies exactly ONE slot: all rows
+      of a key share (h1, h2), hence the same probe sequence and the
+      same resolution round);
+    * ``unresolved_mask`` — [N] bool, rows the caller must still fold in
+      exactly (engine.py routes them through sort+segment-reduce).
+    """
+    if combine not in _COMBINE_INIT:
+        raise ValueError(f"combine must be one of {sorted(_COMBINE_INIT)}")
+    lanes, values, valid = batch.key_lanes, batch.values, batch.valid
+    n_lanes = lanes.shape[-1]
+    T = out_size
+
+    h1, h2 = packing.hash_pair(lanes)
+    folded = h1 >> 1                       # < 0x7FFFFFFF < the empty sentinel
+    step = h2 | jnp.uint32(1)              # odd: full cycle when T is 2^k
+    sentinel = jnp.uint32(0xFFFFFFFF)
+
+    # Belt-and-braces: a "valid" row whose lane0 is 0 would alias the
+    # empty-slot sentinel; leave such rows to the exact fallback.
+    unresolved = valid & (lanes[:, 0] != 0)
+
+    stored_lanes = jnp.zeros((T + 1, n_lanes), jnp.uint32)  # row T = dump
+    acc = jnp.full((T + 1,), _COMBINE_INIT[combine], jnp.int32)
+
+    if combine == "count":
+        values = jnp.ones_like(values)
+
+    for p in range(probes):
+        slot = ((h1 + jnp.uint32(p) * step) % jnp.uint32(T)).astype(jnp.int32)
+        # 1. Compete: smallest folded hash wins the slot this round.
+        claim = jnp.full((T,), sentinel).at[slot].min(
+            jnp.where(unresolved, folded, sentinel), mode="drop"
+        )
+        won = unresolved & (claim[slot] == folded)
+        # 2. Winners write their key into EMPTY slots (dump row for the
+        #    rest keeps the scatter shape static).
+        empty = stored_lanes[:T, 0] == 0
+        writer = won & empty[slot]
+        stored_lanes = stored_lanes.at[
+            jnp.where(writer, slot, T)
+        ].set(lanes, mode="drop")
+        # 3. Resolve by FULL-key equality with whatever the slot holds
+        #    (this round's winner, or an earlier round's occupant).
+        match = unresolved & jnp.all(
+            stored_lanes[slot] == lanes, axis=-1
+        )
+        # 4. Combine resolved values into the slot (dump row otherwise).
+        vslot = jnp.where(match, slot, T)
+        if combine in ("sum", "count"):
+            acc = acc.at[vslot].add(values, mode="drop")
+        elif combine == "min":
+            acc = acc.at[vslot].min(values, mode="drop")
+        else:
+            acc = acc.at[vslot].max(values, mode="drop")
+        unresolved = unresolved & ~match
+
+    used = stored_lanes[:T, 0] != 0
+    table = KVBatch(
+        key_lanes=stored_lanes[:T],
+        values=jnp.where(used, acc[:T], 0),
+        valid=used,
+    )
+    # Rows guarded out of the probe rounds (lane0 == 0, sentinel alias)
+    # re-enter the returned mask: the CONTRACT is that everything not in
+    # the table comes back as unresolved, so no caller path can lose
+    # them silently.
+    unresolved = unresolved | (valid & (lanes[:, 0] == 0))
+    return table, jnp.sum(used.astype(jnp.int32)), unresolved
+
+
+# Residual-buffer capacity for ``place_residual``: unresolved rows are
+# compacted into this many slots and sorted there (a 4096-row sort is
+# milliseconds).  More unresolved rows than this sends the engine to the
+# full-sort fallback instead — with 4 probes at sane load factors that is
+# astronomically rare, but the bound is what keeps the mode EXACT.
+RESIDUAL_CAP = 4096
+
+
+def place_residual(
+    table: KVBatch,
+    used: jax.Array,
+    batch: KVBatch,
+    unresolved: jax.Array,
+    combine: str = "sum",
+) -> tuple[KVBatch, jax.Array]:
+    """Exactly fold ``unresolved`` rows of ``batch`` into ``table``.
+
+    The cheap middle path between "all rows resolved" and the full-sort
+    fallback: probe exhaustion strands only a handful of rows (a key that
+    deterministically loses every probe round re-fails every fold, so
+    this path is on the steady-state fold of real corpora), and sorting
+    a RESIDUAL_CAP-row buffer costs milliseconds where re-sorting the
+    whole (table + emits) batch would cost more than the sort mode this
+    mode exists to beat.
+
+    Caller guarantees ``sum(unresolved) <= RESIDUAL_CAP``.  Steps:
+
+      1. cumsum-compact the unresolved rows into a RESIDUAL_CAP buffer;
+      2. group+total the buffer with the stock sort + segment reduce
+         (residual keys are NEVER already in the table — they failed the
+         full-lane match at every probe — so totals are disjoint);
+      3. place the k-th residual key into the k-th empty slot (rank maps
+         built with one cumsum each).  Keys beyond the empty-slot count
+         are dropped but still counted in the returned distinct total,
+         so capacity truncation stays observable exactly like the sort
+         path's head-slice (reduce_stage.segment_reduce_into).
+
+    Returns ``(merged_table, distinct_total)``.
+    """
+    from locust_tpu.ops.process_stage import sort_and_compact
+    from locust_tpu.ops.reduce_stage import segment_reduce_into
+
+    T = table.size
+    n_lanes = table.key_lanes.shape[-1]
+    cap = RESIDUAL_CAP
+
+    # 1. Compact unresolved rows into the small buffer (dump row = cap).
+    pos = jnp.cumsum(unresolved.astype(jnp.int32)) - 1
+    idx = jnp.where(unresolved & (pos < cap), pos, cap)
+    rlanes = jnp.zeros((cap + 1, n_lanes), jnp.uint32).at[idx].set(
+        batch.key_lanes, mode="drop"
+    )
+    rvals = jnp.zeros((cap + 1,), jnp.int32).at[idx].set(
+        batch.values, mode="drop"
+    )
+    rvalid = jnp.zeros((cap + 1,), bool).at[idx].set(
+        unresolved, mode="drop"
+    )
+    rbatch = KVBatch(rlanes[:cap], rvals[:cap], rvalid[:cap])
+
+    # 2. Group + total the residual keys (tiny sort).
+    rtab, rdist = segment_reduce_into(
+        sort_and_compact(rbatch, "hashp1"), cap, combine
+    )
+
+    # 3. k-th residual key -> k-th empty slot.
+    empty = ~table.valid
+    erank = jnp.cumsum(empty.astype(jnp.int32)) - 1
+    slot_by_rank = jnp.zeros((cap + 1,), jnp.int32).at[
+        jnp.where(empty & (erank < cap), erank, cap)
+    ].set(jnp.arange(T, dtype=jnp.int32), mode="drop")[:cap]
+    n_empty = T - used
+    placeable = rtab.valid & (
+        jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(n_empty, cap)
+    )
+    target = jnp.where(placeable, slot_by_rank, T)  # dump row = T
+
+    lanes_pad = jnp.concatenate(
+        [table.key_lanes, jnp.zeros((1, n_lanes), jnp.uint32)]
+    ).at[target].set(rtab.key_lanes, mode="drop")
+    vals_pad = jnp.concatenate(
+        [table.values, jnp.zeros((1,), jnp.int32)]
+    ).at[target].set(rtab.values, mode="drop")
+    valid_pad = jnp.concatenate(
+        [table.valid, jnp.zeros((1,), bool)]
+    ).at[target].set(placeable, mode="drop")
+
+    merged = KVBatch(lanes_pad[:T], vals_pad[:T], valid_pad[:T])
+    return merged, used + rdist

@@ -57,6 +57,13 @@ def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
         return _hashp2_sort(batch)
     if mode == "hashp1":
         return _hashp1_sort(batch)
+    if mode == "hasht":
+        # "hasht" is a FOLD-level strategy (engine.fold_block_hasht
+        # aggregates without sorting, ops/hash_table.py); consumers of the
+        # grouping interface (mesh engines, timed_run's split stages, the
+        # staged CLI) get the stock formulation with the same key-grouping
+        # guarantees.
+        return _hashp1_sort(batch)
     if mode == "hash1":
         return _hash1_sort(batch)
     if mode == "radix":

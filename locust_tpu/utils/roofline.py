@@ -58,6 +58,7 @@ _MODE_OPERANDS = {
     "hashp": (3, None, False),  # 3 hash keys + row payload
     "hashp2": (2, None, False),  # folded hash + h2 tiebreak + row payload
     "hashp1": (1, None, False),  # folded hash only + row payload
+    "hasht": (1, None, False),  # scatter rounds modeled via sort_pass_count
     "hash1": (2, 0, True),     # (folded key, idx), then row gather
     "radix": (2, 0, True),     # folded key + rank arrays, then row gather
     "bitonic": (1, None, False),  # folded key + row payload, VMEM tiles
@@ -88,6 +89,13 @@ def sort_pass_count(n_rows: int, mode: str = "hash") -> int:
         return 0
     if mode == "radix":
         return _RADIX_PASSES
+    if mode == "hasht":
+        # Not a sort: ~2 row-sized gather/scatter sweeps per probe round
+        # (claim + lanes-verify + value-combine, ops/hash_table.py) — an
+        # order-of-magnitude model, like the radix constant above.
+        from locust_tpu.config import HASHT_PROBES
+
+        return 2 * HASHT_PROBES
     k = math.ceil(math.log2(n_rows))
     if mode == "bitonic":
         # HBM round-trips of the Pallas tiled network = entries in the

@@ -31,8 +31,8 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 # the Pallas bitonic kernel (Mosaic verdict) and the new minimum-traffic
 # hashp1 vs the measured winner hashp2 (57.6 MB/s on-hardware) — before
 # re-timing the also-rans.
-AB_SORT_MODES = ("bitonic", "hashp1", "hashp2", "hashp", "hash", "hash1",
-                 "radix")
+AB_SORT_MODES = ("bitonic", "hasht", "hashp1", "hashp2", "hashp", "hash",
+                 "hash1", "radix")
 
 
 def tunnel_gate() -> bool:

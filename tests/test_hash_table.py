@@ -1,0 +1,203 @@
+"""ops/hash_table.py — the sort-free Process+Reduce (sort_mode="hasht").
+
+The aggregation must be EXACT (never merge distinct keys, never lose a
+row silently): resolution requires a full-key-lane match, and anything
+unresolved is handed back for the engine's stock sort fallback.  Oracles
+are collections.Counter / dict folds, as everywhere in the suite.
+"""
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.core import bytes_ops
+from locust_tpu.core.kv import KVBatch
+from locust_tpu.engine import MapReduceEngine
+from locust_tpu.ops.hash_table import hash_aggregate
+
+
+def _batch(words, values=None, valid=None):
+    keys = jnp.asarray(bytes_ops.strings_to_rows(list(words), 32))
+    if values is None:
+        values = jnp.ones(len(words), jnp.int32)
+    else:
+        values = jnp.asarray(values, jnp.int32)
+    if valid is None:
+        valid = jnp.asarray([bool(w) for w in words])
+    else:
+        valid = jnp.asarray(valid)
+    return KVBatch.from_bytes(keys, values, valid)
+
+
+def _table_dict(table):
+    return {
+        k: v
+        for (k, v) in zip(
+            bytes_ops.rows_to_strings(np.asarray(table.keys_bytes())),
+            np.asarray(table.values),
+        )
+        if k
+    }
+
+
+def test_sum_matches_counter_oracle():
+    rng = np.random.default_rng(7)
+    vocab = [f"w{i}".encode() for i in range(300)]
+    words = [vocab[i] for i in rng.integers(0, len(vocab), 5000)]
+    table, used, unresolved = hash_aggregate(_batch(words), 1024)
+    assert int(np.asarray(unresolved).sum()) == 0
+    oracle = collections.Counter(words)
+    assert _table_dict(table) == dict(oracle)
+    assert int(used) == len(oracle)
+
+
+@pytest.mark.parametrize("combine", ["min", "max"])
+def test_min_max_combines(combine):
+    rng = np.random.default_rng(11)
+    words = [f"k{i % 37}".encode() for i in range(400)]
+    values = rng.integers(-1000, 1000, len(words))
+    table, _, unresolved = hash_aggregate(
+        _batch(words, values=values), 256, combine=combine
+    )
+    assert int(np.asarray(unresolved).sum()) == 0
+    op = min if combine == "min" else max
+    oracle: dict[bytes, int] = {}
+    for w, v in zip(words, values):
+        oracle[w] = op(oracle[w], int(v)) if w in oracle else int(v)
+    assert _table_dict(table) == oracle
+
+
+def test_invalid_rows_ignored():
+    words = [b"a", b"", b"b", b"", b"a"]
+    table, used, unresolved = hash_aggregate(_batch(words), 64)
+    assert int(np.asarray(unresolved).sum()) == 0
+    assert _table_dict(table) == {b"a": 2, b"b": 1}
+    assert int(used) == 2
+
+
+def test_probe_exhaustion_returns_unresolved_not_wrong():
+    """More distinct keys than slots: the overflow MUST surface as
+    unresolved rows (for the engine's exact sort fallback), and every
+    key that did land must still carry its exact total."""
+    words = [f"key{i}".encode() for i in range(64)] * 3
+    table, used, unresolved = hash_aggregate(_batch(words), 16)
+    n_un = int(np.asarray(unresolved).sum())
+    assert n_un > 0  # 64 distinct cannot fit 16 slots
+    got = _table_dict(table)
+    assert len(got) == int(used) <= 16
+    # Resolved keys are exact; unresolved rows of a key are all-or-none
+    # (same key => same probe sequence => same resolution round).
+    for k, v in got.items():
+        assert v == 3, (k, v)
+    resolved_total = sum(got.values())
+    assert resolved_total + n_un == len(words)
+
+
+def test_distinct_keys_sharing_slots_never_merge():
+    """Keys engineered to collide (tiny table forces shared probe paths)
+    must either occupy separate slots or fall to unresolved — never sum
+    into one slot."""
+    rng = np.random.default_rng(3)
+    vocab = [f"word{i}".encode() for i in range(40)]
+    words = [vocab[i] for i in rng.integers(0, len(vocab), 400)]
+    table, _, unresolved = hash_aggregate(_batch(words), 32)
+    got = _table_dict(table)
+    oracle = collections.Counter(words)
+    for k, v in got.items():
+        assert v == oracle[k], f"{k!r} merged or lost counts"
+
+
+@pytest.mark.parametrize("n_lines", [37, 700])
+def test_engine_hasht_oracle_exact(n_lines):
+    """End-to-end WordCount with sort_mode='hasht' equals the pure-Python
+    oracle — the same bar every sort mode passes (test_pipeline)."""
+    lines = open("/root/reference/hamlet.txt", "rb").read().splitlines()[
+        :n_lines
+    ]
+    eng = MapReduceEngine(EngineConfig(block_lines=512, sort_mode="hasht"))
+    res = eng.run_lines(lines)
+    got = dict(res.to_host_pairs())
+    assert got == py_wordcount(lines)
+    assert not res.truncated
+
+
+def test_engine_hasht_fallback_under_capacity_pressure():
+    """Table smaller than the vocabulary: the lax.cond sort fallback must
+    fire and keep the answer exact (and flag truncation honestly when
+    distinct exceeds capacity)."""
+    lines = [b"alpha beta gamma delta epsilon zeta eta theta"] * 4 + [
+        f"unique{i}".encode() for i in range(200)
+    ]
+    eng = MapReduceEngine(
+        EngineConfig(block_lines=64, sort_mode="hasht", table_size=4096)
+    )
+    res = eng.run_lines(lines)
+    assert dict(res.to_host_pairs()) == py_wordcount(lines)
+
+
+def test_engine_hasht_truncation_flag():
+    """Same truncation-observability bar as the sort modes
+    (test_pipeline.test_truncation_flag_survives_later_merges): distinct
+    beyond table capacity must set the flag even when a later fold's
+    distinct fits."""
+    cfg = EngineConfig(
+        block_lines=2, emits_per_line=4, table_size=8, sort_mode="hasht"
+    )
+    lines = [
+        b"a b c d",
+        b"e f g h",
+        b"i j k l",  # 12 distinct > 8 slots
+        b"",
+        b"a b c d",
+        b"",
+    ]
+    for runner in ("run", "run_fused"):
+        eng = MapReduceEngine(cfg)
+        res = getattr(eng, runner)(eng.rows_from_lines(lines))
+        assert res.truncated, runner
+
+
+def test_place_residual_merges_exactly():
+    """Direct middle-path check: force probe exhaustion with a tiny
+    table, then verify place_residual lands every placeable key with its
+    exact total and reports the true distinct count."""
+    from locust_tpu.ops.hash_table import place_residual
+
+    words = [f"key{i}".encode() for i in range(40)] * 5
+    batch = _batch(words)
+    table, used, unresolved = hash_aggregate(batch, 64)
+    merged, distinct = place_residual(table, used, batch, unresolved)
+    assert int(distinct) == 40
+    got = _table_dict(merged)
+    assert got == dict(collections.Counter(words))
+
+
+def test_lane0_zero_rows_return_as_unresolved():
+    """A valid row whose key lane 0 is zero aliases the empty-slot
+    sentinel and is guarded out of the probe rounds — the contract is
+    that it comes BACK in the unresolved mask (for the engine's exact
+    fallback), never silently dropped (code-review finding, round 4)."""
+    zero_key = jnp.zeros((2, 8), jnp.uint32)
+    zero_key = zero_key.at[1, 1].set(0x61000000)  # lane0 still 0
+    batch = KVBatch(
+        key_lanes=zero_key,
+        values=jnp.asarray([7, 1], jnp.int32),
+        valid=jnp.asarray([True, True]),
+    )
+    table, used, unresolved = hash_aggregate(batch, 16)
+    assert list(np.asarray(unresolved)) == [True, True]
+    assert int(used) == 0
+
+
+def test_debug_checks_accept_hasht_tables(monkeypatch):
+    """LOCUST_DEBUG_CHECKS must not reject hasht's slot-ordered (non
+    prefix-compact) tables — reproduces the round-4 review finding."""
+    monkeypatch.setenv("LOCUST_DEBUG_CHECKS", "1")
+    eng = MapReduceEngine(EngineConfig(block_lines=8, sort_mode="hasht"))
+    res = eng.run_lines([b"a b a", b"c d"])
+    assert dict(res.to_host_pairs()) == {b"a": 2, b"b": 1, b"c": 1, b"d": 1}
