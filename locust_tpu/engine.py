@@ -140,78 +140,24 @@ class MapReduceEngine:
         tsize = cfg.resolved_table_size
         mode = cfg.sort_mode
 
+        from locust_tpu.ops.hash_table import reduce_into
+
         def fold_block(acc: KVBatch, lines: jax.Array):
             """Map one block and merge its emits into the running table.
 
-            ONE sort of (table_size + emits_per_block) rows does both the
-            block's shuffle-grouping and the cross-block merge; the running
-            distinct-key count is measured BEFORE the capacity slice so a
-            truncation in any fold is observable.
+            Sort modes: ONE sort of (table_size + emits_per_block) rows
+            does both the block's shuffle-grouping and the cross-block
+            merge.  Mode "hasht": the sort-free scatter fold with its
+            exactness ladder does the same in O(n)
+            (ops/hash_table.aggregate_exact).  Either way the running
+            distinct-key count is measured BEFORE the capacity slice so
+            a truncation in any fold is observable.
             """
             kv, overflow = map_fn(lines, cfg)
-            merged, distinct = segment_reduce_into(
-                sort_and_compact(KVBatch.concat(acc, kv), mode), tsize, combine
+            merged, distinct = reduce_into(
+                KVBatch.concat(acc, kv), tsize, combine, mode
             )
             return merged, overflow, distinct
-
-        def fold_block_hasht(acc: KVBatch, lines: jax.Array):
-            """Sort-free fold: scatter-aggregate straight into the table.
-
-            ``hash_aggregate`` replaces the sort AND the segment reduce
-            AND the accumulator merge in one O(n) pass (ops/hash_table.py).
-            Three-way exactness ladder on the unresolved-row count (a key
-            that loses every probe round loses them deterministically on
-            EVERY fold, so the middle path is steady-state, not rare):
-
-              0 unresolved           -> the table is the answer;
-              <= RESIDUAL_CAP        -> compact the stragglers into a
-                                        small buffer, sort only that, and
-                                        place them into empty slots
-                                        (place_residual — milliseconds);
-              >  RESIDUAL_CAP        -> the full stock sort fallback
-                                        (correctness anchor; near-capacity
-                                        load only).
-
-            Never wrong, and truncation stays as observable as in the
-            sort modes (each path returns the pre-capacity distinct).
-            """
-            from locust_tpu.ops.hash_table import (
-                RESIDUAL_CAP,
-                hash_aggregate,
-                place_residual,
-            )
-
-            kv, overflow = map_fn(lines, cfg)
-            both = KVBatch.concat(acc, kv)
-            table, used, unresolved = hash_aggregate(both, tsize, combine)
-            n_unres = jnp.sum(unresolved.astype(jnp.int32))
-
-            def fast(_):
-                return table, used
-
-            def small(_):
-                return place_residual(table, used, both, unresolved, combine)
-
-            def full(_):
-                resid = KVBatch(both.key_lanes, both.values, unresolved)
-                return segment_reduce_into(
-                    sort_and_compact(KVBatch.concat(table, resid), "hashp1"),
-                    tsize,
-                    combine,
-                )
-
-            merged, distinct = jax.lax.cond(
-                n_unres == 0,
-                fast,
-                lambda op: jax.lax.cond(
-                    n_unres <= RESIDUAL_CAP, small, full, op
-                ),
-                operand=None,
-            )
-            return merged, overflow, distinct
-
-        if mode == "hasht":
-            fold_block = fold_block_hasht
 
         def scan_blocks(blocks: jax.Array):
             """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.

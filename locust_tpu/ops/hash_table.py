@@ -227,3 +227,78 @@ def place_residual(
 
     merged = KVBatch(lanes_pad[:T], vals_pad[:T], valid_pad[:T])
     return merged, used + rdist
+
+
+def reduce_into(
+    batch: KVBatch,
+    out_size: int,
+    combine: str,
+    sort_mode: str,
+    probes: int | None = None,
+) -> tuple[KVBatch, jax.Array]:
+    """THE fold-level reduce dispatch: one place decides sort vs hasht.
+
+    Every bounded-table fold site (engine block fold, mesh per-shard
+    merge, flat local combiner, hierarchical cross-slice combine) calls
+    this instead of hand-rolling the ``if sort_mode == "hasht"`` branch —
+    a new fold-level strategy lands here once, not in four files.
+    """
+    if sort_mode == "hasht":
+        return aggregate_exact(batch, out_size, combine, probes=probes)
+    from locust_tpu.ops.process_stage import sort_and_compact
+    from locust_tpu.ops.reduce_stage import segment_reduce_into
+
+    return segment_reduce_into(
+        sort_and_compact(batch, sort_mode), out_size, combine
+    )
+
+
+def aggregate_exact(
+    batch: KVBatch,
+    out_size: int,
+    combine: str = "sum",
+    probes: int | None = None,
+) -> tuple[KVBatch, jax.Array]:
+    """The full sort-free fold with its exactness ladder, as one call.
+
+    ``hash_aggregate`` + the three-way unresolved-row ladder the engine's
+    "hasht" fold documents (engine.fold_block_hasht): 0 unresolved → the
+    table is the answer; <= RESIDUAL_CAP → ``place_residual``'s small
+    compact-sort-place path; more → the full stock sort fallback.  The
+    single shared implementation for every fold-level consumer (the
+    single-device engine and the mesh shuffle's per-shard merge) — no
+    collectives inside, so it traces under ``shard_map`` with per-shard
+    branch selection.
+
+    Returns ``(table[out_size], distinct)`` with the pre-capacity
+    distinct count (truncation observable, like segment_reduce_into).
+    """
+    from locust_tpu.ops.process_stage import sort_and_compact
+    from locust_tpu.ops.reduce_stage import segment_reduce_into
+
+    table, used, unresolved = hash_aggregate(
+        batch, out_size, combine,
+        probes=DEFAULT_PROBES if probes is None else probes,
+    )
+    n_unres = jnp.sum(unresolved.astype(jnp.int32))
+
+    def fast(_):
+        return table, used
+
+    def small(_):
+        return place_residual(table, used, batch, unresolved, combine)
+
+    def full(_):
+        resid = KVBatch(batch.key_lanes, batch.values, unresolved)
+        return segment_reduce_into(
+            sort_and_compact(KVBatch.concat(table, resid), "hashp1"),
+            out_size,
+            combine,
+        )
+
+    return jax.lax.cond(
+        n_unres == 0,
+        fast,
+        lambda op: jax.lax.cond(n_unres <= RESIDUAL_CAP, small, full, op),
+        operand=None,
+    )

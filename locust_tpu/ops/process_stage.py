@@ -58,11 +58,11 @@ def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
     if mode == "hashp1":
         return _hashp1_sort(batch)
     if mode == "hasht":
-        # "hasht" is a FOLD-level strategy (engine.fold_block_hasht
-        # aggregates without sorting, ops/hash_table.py); consumers of the
-        # grouping interface (mesh engines, timed_run's split stages, the
-        # staged CLI) get the stock formulation with the same key-grouping
-        # guarantees.
+        # "hasht" is a FOLD-level strategy (ops/hash_table.aggregate_exact;
+        # wired in engine.fold_block_hasht and the mesh engines' merge /
+        # combiner sites); consumers of the grouping interface proper
+        # (timed_run's split stages, the staged CLI) get the stock
+        # formulation with the same key-grouping guarantees.
         return _hashp1_sort(batch)
     if mode == "hash1":
         return _hash1_sort(batch)

@@ -45,8 +45,8 @@ from jax.sharding import PartitionSpec as P
 from locust_tpu.config import EngineConfig
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
-from locust_tpu.ops.process_stage import sort_and_compact
-from locust_tpu.ops.reduce_stage import normalize_combine, segment_reduce_into
+from locust_tpu.ops.hash_table import reduce_into
+from locust_tpu.ops.reduce_stage import normalize_combine
 from locust_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS
 from locust_tpu.parallel.shuffle import (
     RoundStats,
@@ -148,10 +148,10 @@ class HierarchicalMapReduce:
             values = jax.lax.all_gather(acc.values, slice_axis, axis=0, tiled=True)
             valid = jax.lax.all_gather(acc.valid, slice_axis, axis=0, tiled=True)
             gathered = KVBatch(key_lanes=lanes, values=values, valid=valid)
-            merged, distinct = segment_reduce_into(
-                sort_and_compact(gathered, cfg.sort_mode),
-                self.shard_capacity,
-                norm_combine,
+            # reduce_into dispatches sort vs the "hasht" sort-free fold
+            # (no collectives inside; the all_gathers above already ran).
+            merged, distinct = reduce_into(
+                gathered, self.shard_capacity, norm_combine, cfg.sort_mode
             )
             # Global distinct: shards are hash-disjoint within a slice
             # column, identical across slices post-merge -> sum over data.

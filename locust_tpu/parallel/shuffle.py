@@ -38,8 +38,7 @@ from locust_tpu.config import EngineConfig
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
-from locust_tpu.ops.process_stage import sort_and_compact
-from locust_tpu.ops.reduce_stage import segment_reduce, segment_reduce_into
+from locust_tpu.ops.hash_table import reduce_into
 from locust_tpu.parallel.mesh import DATA_AXIS
 
 logger = logging.getLogger("locust_tpu")
@@ -417,11 +416,12 @@ def build_shuffle_step(
             valid=recv_valid.reshape(-1),
         )
         # Merge what we received with our carried shard, re-reduce.
+        # reduce_into dispatches sort vs the "hasht" sort-free fold (no
+        # collectives inside, so each shard branches its exactness ladder
+        # independently under shard_map).
         both = KVBatch.concat(acc, received)
-        new_acc, distinct = segment_reduce_into(
-            sort_and_compact(both, cfg.sort_mode),
-            shard_capacity,
-            combine,
+        new_acc, distinct = reduce_into(
+            both, shard_capacity, combine, cfg.sort_mode
         )
         # The backlog rides psum over stat_axes so every device in the
         # shuffle group sees the same value — which is what lets the drain
@@ -443,7 +443,18 @@ def build_shuffle_step(
         stats every ``stats_sync_every`` rounds.
         """
         kv, emit_ovf = map_fn(lines, cfg)
-        local_table = segment_reduce(sort_and_compact(kv, cfg.sort_mode), combine)
+        # Local combiner: same capacity contract either way (output size ==
+        # kv.size, the shape partition_to_bins was sized for); partition is
+        # order-agnostic, so hasht's slot-ordered table needs no compaction.
+        # hasht runs with probes=2 HERE (bounded regret): unlike the merge
+        # sites, this table is sized at kv.size, so a distinct-heavy
+        # workload can drive the load factor toward 1.0 where probing
+        # mostly fails — two cheap rounds bound the worst case at the old
+        # sort cost + ~2 scatter sweeps while keeping the full win on
+        # duplicate-heavy workloads (WordCount-like).
+        local_table = reduce_into(
+            kv, kv.size, combine, cfg.sort_mode, probes=2
+        )[0]
         acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(
             local_table, acc, leftover
         )

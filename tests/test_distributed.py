@@ -432,3 +432,48 @@ def test_shard_capacity_honors_table_size():
     hres = h.run(rows)
     assert not hres.truncated
     assert dict(hres.to_host_pairs()) == want
+
+
+def test_mesh_engines_hasht_sort_free_fold():
+    """sort_mode="hasht" runs the sort-free aggregate_exact at the
+    per-shard merge AND the local combiner (flat) AND the cross-slice
+    combine (hierarchical), each branching its exactness ladder
+    per-shard under shard_map — oracle-exact on both engines."""
+    from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+    from locust_tpu.parallel.mesh import make_mesh, make_mesh_2d
+
+    lines = [b"to be or not to be", b"that is the question", b"the the"] * 8
+    cfg = small_cfg(sort_mode="hasht")
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    want = dict(py_wordcount(lines, cfg.emits_per_line))
+    res = DistributedMapReduce(make_mesh(8), cfg).run(rows)
+    assert dict(res.to_host_pairs()) == want
+    res = HierarchicalMapReduce(make_mesh_2d(2, 4), cfg).run(rows)
+    assert dict(res.to_host_pairs()) == want
+
+
+def test_mesh_hasht_residual_branches_under_pressure():
+    """Force the hasht exactness ladder OFF its fast path under
+    shard_map: ~80% load factor on each shard's table makes probe
+    exhaustion near-certain, so the place_residual (and possibly full
+    sort) branches run inside the drain while_loop — the answer must
+    stay oracle-exact (review finding: the fast path alone was tested)."""
+    from locust_tpu.parallel.mesh import make_mesh
+
+    # ~26k distinct words -> ~3.3k per shard against the 4096-row
+    # shard-capacity floor (~0.8 load), far above the ~0.09 the probe
+    # scheme is tuned for.
+    words = [b"w%d" % i for i in range(26_000)]
+    lines = [b" ".join(words[i : i + 8]) for i in range(0, len(words), 8)]
+    cfg = small_cfg(
+        block_lines=512,
+        emits_per_line=8,
+        line_width=128,
+        table_size=4096,
+        sort_mode="hasht",
+    )
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    res = DistributedMapReduce(make_mesh(8), cfg).run(rows)
+    assert dict(res.to_host_pairs()) == dict(
+        py_wordcount(lines, cfg.emits_per_line)
+    )
