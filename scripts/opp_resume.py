@@ -344,6 +344,11 @@ def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
             "total_ms": round(best.times.total_ms, 1),
             "distinct": best.num_segments,
         }
+        if sort_mode == "hasht":
+            # timed_run splits stages via the grouping interface, which
+            # for hasht is the stock hashp1 formulation — the fused fold
+            # (the number that wins A/Bs) has no separable Process/Reduce.
+            row["note"] = "stages measured via hashp1-equivalent split"
     except Exception as e:  # noqa: BLE001 - informational phase: a failure
         # here must not kill stage_parity/emits/key-width/stream behind it
         row = {
