@@ -202,6 +202,15 @@ def main() -> int:
 
     if mode == "wordcount":
         run_wordcount(DistributedMapReduce(mesh, cfg), cfg, out)
+    elif mode == "hasht":
+        # The sort-free fold under REAL multi-process collectives: the
+        # per-shard aggregate_exact ladder (scatters + nested lax.cond)
+        # composing with cross-process all_to_all is exactly what the
+        # single-process 8-device mesh cannot prove.
+        import dataclasses as _dc
+
+        hcfg = _dc.replace(cfg, sort_mode="hasht")
+        run_wordcount(DistributedMapReduce(mesh, hcfg), hcfg, out)
     elif mode == "checkpoint":
         _crash_resume(
             lambda: DistributedMapReduce(make_mesh(), cfg),

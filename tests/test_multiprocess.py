@@ -289,3 +289,12 @@ def test_cli_pod_launch(tmp_path):
         _wordcount_oracle(len(BASE * 8))
     )
     assert table_of(outs[1].read_bytes()) == {}  # only process 0 prints
+
+
+def test_two_process_hasht(tmp_path):
+    """The sort-free fold's scatters + nested lax.cond ladder under REAL
+    cross-process collectives (not just the single-process virtual
+    mesh) — oracle-exact."""
+    result = _run_workers(tmp_path, "hasht")
+    got = {k.encode(): v for k, v in result["pairs"]}
+    assert got == dict(_wordcount_oracle(result["n_lines"]))
