@@ -201,3 +201,19 @@ def test_debug_checks_accept_hasht_tables(monkeypatch):
     eng = MapReduceEngine(EngineConfig(block_lines=8, sort_mode="hasht"))
     res = eng.run_lines([b"a b a", b"c d"])
     assert dict(res.to_host_pairs()) == {b"a": 2, b"b": 1, b"c": 1, b"d": 1}
+
+
+def test_hasht_scan_lowers_for_tpu():
+    """The full-corpus hasht fold (scatters + nested lax.cond inside
+    lax.scan) must lower to TPU StableHLO off-hardware — the same
+    pre-hardware gate the bitonic kernel gets, so a lowering regression
+    is caught before it costs a tunnel window."""
+    import jax
+
+    cfg = EngineConfig(
+        block_lines=256, sort_mode="hasht", key_width=16, emits_per_line=8
+    )
+    eng = MapReduceEngine(cfg)
+    shape = jax.ShapeDtypeStruct((2, 256, cfg.line_width), jnp.uint8)
+    exp = jax.export.export(eng._scan_blocks, platforms=["tpu"])(shape)
+    assert len(exp.mlir_module()) > 0
