@@ -75,9 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     from locust_tpu.config import SORT_MODES
 
     p.add_argument("--sort-mode", choices=list(SORT_MODES),
-                   default="hash",
+                   default=None,
                    help="Process-stage sort strategy (config.EngineConfig."
-                        "sort_mode); variant timings in artifacts/")
+                        "sort_mode); default follows the measured "
+                        "per-backend choice (config.default_sort_mode); "
+                        "variant timings in artifacts/")
     p.add_argument("--mesh", action="store_true",
                    help="run stage 0/1 on ALL visible devices via the "
                         "all-to-all shuffle engine (DistributedMapReduce) "
@@ -174,11 +176,18 @@ def _run(args) -> int:
         args.mesh = True  # --slices implies the mesh engine; never ignore it
 
     # Import jax lazily so --help works instantly.
-    from locust_tpu.config import EngineConfig
+    from locust_tpu.config import EngineConfig, default_sort_mode
     from locust_tpu.core.kv import KVBatch
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.io import loader, serde
+    import jax
     import jax.numpy as jnp
+
+    if args.sort_mode is None:
+        # Safe to touch jax here: select_backend_cli above already pinned
+        # the platform (a wedged tunnel was handled there), so
+        # default_backend() initializes exactly what was selected.
+        args.sort_mode = default_sort_mode(jax.default_backend())
 
     cfg = EngineConfig(
         block_lines=args.block_lines,

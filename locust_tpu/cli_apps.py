@@ -141,7 +141,9 @@ def run_pagerank(args) -> int:
 
 
 def _load_docs(args):
-    from locust_tpu.config import EngineConfig
+    import jax
+
+    from locust_tpu.config import EngineConfig, default_sort_mode
     from locust_tpu.io import loader
 
     cfg = EngineConfig(
@@ -149,6 +151,9 @@ def _load_docs(args):
         line_width=args.line_width,
         key_width=args.key_width,
         emits_per_line=args.emits_per_line,
+        # Measured per-backend Process default (backend already selected
+        # by main's select_backend_cli); apps inherit the same fold wins.
+        sort_mode=default_sort_mode(jax.default_backend()),
     )
     rows = loader.load_rows(args.filename, cfg.line_width)
     ids = (np.arange(rows.shape[0]) // args.lines_per_doc).astype(np.int32)

@@ -30,6 +30,20 @@ SORT_MODES = (
     "hasht",
 )
 
+
+def default_sort_mode(backend: str) -> str:
+    """Measured per-backend default Process strategy.
+
+    CPU: "hasht" wins the driver-policy grid decisively
+    (artifacts/bench_block_cpu_r4.jsonl: 7.94 vs hash1's 5.14 MB/s) and
+    is soak-proven (260-case battery).  TPU: payload-carry "hashp" per
+    the committed on-hardware variant row (artifacts/tpu_runs.jsonl
+    sort_variants); bench.py's evidence tuning supersedes this with the
+    latest engine-level A/B row at bench time.  Anything else: the
+    portable "hash".
+    """
+    return {"cpu": "hasht", "tpu": "hashp"}.get(backend, "hash")
+
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.
 PAD_BYTE: int = 0
