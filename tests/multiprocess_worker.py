@@ -216,6 +216,17 @@ def main() -> int:
             lambda: DistributedMapReduce(make_mesh(), cfg),
             cfg, out, checkpoint_dir,
         )
+    elif mode == "hasht_checkpoint":
+        # Crash+resume with hasht's SLOT-ORDERED accumulator tables: the
+        # snapshot/scatter-resume path must round-trip a table whose
+        # valid rows are hash-scattered, not prefix-compacted.
+        import dataclasses as _dc
+
+        hcfg = _dc.replace(cfg, sort_mode="hasht")
+        _crash_resume(
+            lambda: DistributedMapReduce(make_mesh(), hcfg),
+            hcfg, out, checkpoint_dir,
+        )
     elif mode == "invindex":
         run_invindex(mesh, cfg, out)
     elif mode == "samplesort":

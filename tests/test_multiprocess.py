@@ -298,3 +298,16 @@ def test_two_process_hasht(tmp_path):
     result = _run_workers(tmp_path, "hasht")
     got = {k.encode(): v for k, v in result["pairs"]}
     assert got == dict(_wordcount_oracle(result["n_lines"]))
+
+
+@pytest.mark.slow
+def test_two_process_hasht_checkpoint_resume(tmp_path):
+    """Crash+resume with hasht: snapshots hold SLOT-ORDERED (non
+    prefix-compact) accumulator tables; the scatter-resume and the
+    continued sort-free folds must still reproduce the exact table."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    result = _run_workers(tmp_path, "hasht_checkpoint", (str(ckpt),))
+    got = {k.encode(): v for k, v in result["pairs"]}
+    assert got == dict(_wordcount_oracle(result["n_lines"]))
+    assert result["resumed_rounds"] == result["nrounds"] - 2
