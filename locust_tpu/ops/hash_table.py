@@ -51,7 +51,11 @@ from locust_tpu.core.kv import KVBatch
 # fraction after 4 rounds is ~0.09^4 ≈ 7e-5 of KEYS — in practice zero,
 # so the engine's fallback `lax.cond` almost never fires.
 
-_COMBINE_INIT = {"sum": 0, "count": 0, "min": 2**31 - 1, "max": -(2**31)}
+# Associative combiners only: "count" is rejected at the aggregate_exact
+# gate (it is not a monoid over its own outputs — a mixed batch of raw
+# emits and pre-aggregated table rows has no correct single-pass count);
+# normalize_combine lowers it to emit-1 + "sum" before any fold.
+_COMBINE_INIT = {"sum": 0, "min": 2**31 - 1, "max": -(2**31)}
 
 
 def hash_aggregate(
@@ -92,9 +96,6 @@ def hash_aggregate(
     stored_lanes = jnp.zeros((T + 1, n_lanes), jnp.uint32)  # row T = dump
     acc = jnp.full((T + 1,), _COMBINE_INIT[combine], jnp.int32)
 
-    if combine == "count":
-        values = jnp.ones_like(values)
-
     for p in range(probes):
         slot = ((h1 + jnp.uint32(p) * step) % jnp.uint32(T)).astype(jnp.int32)
         # 1. Compete: smallest folded hash wins the slot this round.
@@ -116,7 +117,7 @@ def hash_aggregate(
         )
         # 4. Combine resolved values into the slot (dump row otherwise).
         vslot = jnp.where(match, slot, T)
-        if combine in ("sum", "count"):
+        if combine == "sum":
             acc = acc.at[vslot].add(values, mode="drop")
         elif combine == "min":
             acc = acc.at[vslot].min(values, mode="drop")
@@ -229,22 +230,80 @@ def place_residual(
     return merged, used + rdist
 
 
+def combine_or_passthrough(
+    batch: KVBatch, combine: str, probes: int = 2
+) -> KVBatch:
+    """Opportunistic pre-aggregation with an O(n) worst case — no sort.
+
+    For the mesh LOCAL COMBINER (shuffle.local_step): aggregation there
+    is an optimization, not a contract — ungrouped rows ship fine
+    (partition is order-agnostic and every destination re-reduces), so
+    when probing fails the right fallback is not a sort but a cheap
+    compaction: resolved table rows and still-raw unresolved rows are
+    cumsum-packed into one batch-sized output (used + n_unres <= valid
+    rows <= batch.size, so nothing can be dropped).  Worst case =
+    ``probes`` scatter sweeps + one O(n) compaction, the bound the
+    probes=2 choice at the call site is justified by.
+
+    Same associativity gate as aggregate_exact ("count" must be lowered
+    first — resolved slots hold partial sums that ship as single rows).
+    """
+    if combine == "count":
+        raise ValueError(
+            "combine_or_passthrough cannot take combine='count'; lower it "
+            "via reduce_stage.normalize_combine to emit-1 + 'sum' first"
+        )
+    N = batch.size
+    n_lanes = batch.key_lanes.shape[-1]
+    table, used, unresolved = hash_aggregate(batch, N, combine, probes=probes)
+
+    def fast(_):
+        return table
+
+    def passthrough(_):
+        rank_t = jnp.cumsum(table.valid.astype(jnp.int32)) - 1
+        dest_t = jnp.where(table.valid, rank_t, N)
+        lanes = jnp.zeros((N + 1, n_lanes), jnp.uint32).at[dest_t].set(
+            table.key_lanes, mode="drop"
+        )
+        vals = jnp.zeros((N + 1,), jnp.int32).at[dest_t].set(
+            table.values, mode="drop"
+        )
+        valid = jnp.zeros((N + 1,), bool).at[dest_t].set(
+            table.valid, mode="drop"
+        )
+        rank_u = jnp.cumsum(unresolved.astype(jnp.int32)) - 1 + used
+        dest_u = jnp.where(unresolved, rank_u, N)
+        lanes = lanes.at[dest_u].set(batch.key_lanes, mode="drop")
+        vals = vals.at[dest_u].set(batch.values, mode="drop")
+        valid = valid.at[dest_u].set(unresolved, mode="drop")
+        return KVBatch(lanes[:N], vals[:N], valid[:N])
+
+    return jax.lax.cond(
+        jnp.sum(unresolved.astype(jnp.int32)) == 0,
+        fast,
+        passthrough,
+        operand=None,
+    )
+
+
 def reduce_into(
     batch: KVBatch,
     out_size: int,
     combine: str,
     sort_mode: str,
-    probes: int | None = None,
 ) -> tuple[KVBatch, jax.Array]:
     """THE fold-level reduce dispatch: one place decides sort vs hasht.
 
     Every bounded-table fold site (engine block fold, mesh per-shard
-    merge, flat local combiner, hierarchical cross-slice combine) calls
-    this instead of hand-rolling the ``if sort_mode == "hasht"`` branch —
-    a new fold-level strategy lands here once, not in four files.
+    merge, hierarchical cross-slice combine) calls this instead of
+    hand-rolling the ``if sort_mode == "hasht"`` branch — a new
+    fold-level strategy lands here once, not in four files.  (The mesh
+    LOCAL COMBINER is the one deliberate exception: aggregation there is
+    optional, so it uses ``combine_or_passthrough``.)
     """
     if sort_mode == "hasht":
-        return aggregate_exact(batch, out_size, combine, probes=probes)
+        return aggregate_exact(batch, out_size, combine)
     from locust_tpu.ops.process_stage import sort_and_compact
     from locust_tpu.ops.reduce_stage import segment_reduce_into
 
@@ -276,6 +335,19 @@ def aggregate_exact(
     from locust_tpu.ops.process_stage import sort_and_compact
     from locust_tpu.ops.reduce_stage import segment_reduce_into
 
+    if combine == "count":
+        # Refuse, don't corrupt: "count" is not a monoid over its own
+        # outputs (normalize_combine, reduce_stage.py), and this ladder's
+        # fallback branches re-reduce batches that may contain
+        # PRE-AGGREGATED table rows — a second "count" over those counts
+        # rows, not occurrences (verified: wrong totals at >RESIDUAL_CAP
+        # unresolved).  Callers must lower count -> emit-1 + "sum" at the
+        # leaves first; every engine/mesh fold site already does.
+        raise ValueError(
+            "aggregate_exact cannot take combine='count' (not associative "
+            "over partial tables); lower it via "
+            "reduce_stage.normalize_combine to emit-1 + 'sum' first"
+        )
     table, used, unresolved = hash_aggregate(
         batch, out_size, combine,
         probes=DEFAULT_PROBES if probes is None else probes,

@@ -445,16 +445,20 @@ def build_shuffle_step(
         kv, emit_ovf = map_fn(lines, cfg)
         # Local combiner: same capacity contract either way (output size ==
         # kv.size, the shape partition_to_bins was sized for); partition is
-        # order-agnostic, so hasht's slot-ordered table needs no compaction.
-        # hasht runs with probes=2 HERE (bounded regret): unlike the merge
-        # sites, this table is sized at kv.size, so a distinct-heavy
-        # workload can drive the load factor toward 1.0 where probing
-        # mostly fails — two cheap rounds bound the worst case at the old
-        # sort cost + ~2 scatter sweeps while keeping the full win on
-        # duplicate-heavy workloads (WordCount-like).
-        local_table = reduce_into(
-            kv, kv.size, combine, cfg.sort_mode, probes=2
-        )[0]
+        # order-agnostic, so neither hasht's slot-ordered table nor the
+        # passthrough's raw rows need grouping.  hasht here uses
+        # combine_or_passthrough: aggregation at this site is an
+        # OPTIMIZATION (every destination re-reduces), so when probing
+        # fails under a distinct-heavy load the fallback is an O(n)
+        # compaction, not a sort — worst case = 2 probe sweeps + one
+        # compaction, full win kept on duplicate-heavy (WordCount-like)
+        # blocks.
+        if cfg.sort_mode == "hasht":
+            from locust_tpu.ops.hash_table import combine_or_passthrough
+
+            local_table = combine_or_passthrough(kv, combine, probes=2)
+        else:
+            local_table = reduce_into(kv, kv.size, combine, cfg.sort_mode)[0]
         acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(
             local_table, acc, leftover
         )

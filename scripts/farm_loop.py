@@ -39,17 +39,12 @@ def log(msg: str) -> None:
 JOB_SCRIPTS = ("bench.py", "tpu_opportunistic.py", "opp_resume.py")
 
 
-def other_jobs_running() -> bool:
-    """True if a bench/sweep PYTHON process is live — the driver's
-    end-of-round bench must win the window, not fight us.
-
-    Reads /proc argv directly instead of ``pgrep -f``: a full-cmdline
-    regex also matches unrelated processes that merely MENTION a script
-    name somewhere in a long argument (observed: the driver harness's own
-    command line), which would make this loop yield forever."""
+def _python_procs_running(names, exclude_self=True):
+    """PIDs of live python processes whose script basename is in ``names``."""
     me = os.getpid()
+    hits = []
     for pid_dir in os.listdir("/proc"):
-        if not pid_dir.isdigit() or int(pid_dir) == me:
+        if not pid_dir.isdigit() or (exclude_self and int(pid_dir) == me):
             continue
         try:
             with open(f"/proc/{pid_dir}/cmdline", "rb") as f:
@@ -59,11 +54,22 @@ def other_jobs_running() -> bool:
         if not argv or b"python" not in os.path.basename(argv[0]):
             continue
         if any(
-            os.path.basename(a.decode(errors="replace")) in JOB_SCRIPTS
+            os.path.basename(a.decode(errors="replace")) in names
             for a in argv[1:3]
         ):
-            return True
-    return False
+            hits.append(int(pid_dir))
+    return hits
+
+
+def other_jobs_running() -> bool:
+    """True if a bench/sweep PYTHON process is live — the driver's
+    end-of-round bench must win the window, not fight us.
+
+    Reads /proc argv directly instead of ``pgrep -f``: a full-cmdline
+    regex also matches unrelated processes that merely MENTION a script
+    name somewhere in a long argument (observed: the driver harness's own
+    command line), which would make this loop yield forever."""
+    return bool(_python_procs_running(JOB_SCRIPTS))
 
 
 def probe() -> bool:
@@ -179,6 +185,15 @@ def main() -> int:
     ap.add_argument("--interval", type=float, default=480.0,
                     help="seconds between probes")
     args = ap.parse_args()
+    # Mutual exclusion: CLAUDE.md says start a loop every session, and a
+    # session restart can leave the previous (self-expiring) loop alive —
+    # two loops would harvest the same single-chip window concurrently
+    # and pollute the decision A/B rows with contended timings.
+    others = _python_procs_running(("farm_loop.py",))
+    if others:
+        log(f"another farm_loop is already running (pid {others[0]}); "
+            "exiting — kill it first to replace the schedule")
+        return 0
     deadline = time.time() + args.hours * 3600
     log(f"farming until {time.strftime('%H:%M:%S', time.localtime(deadline))} "
         f"(probe every {args.interval:.0f}s)")

@@ -152,17 +152,19 @@ def main() -> int:
     if "error" not in row and row.get("matches_oracle"):
         from locust_tpu.ops.pallas.sort import TILE_ROWS
 
-        try:
-            key_np = np.asarray(key)
-            sorted_keys = np.sort(key_np)
-            tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
-                                      "compile_s": 0.0,
-                                      "note": "from bitonic_sort_ab"}}
-            for tr in (128, 256, 512, 1024):
-                if tr == TILE_ROWS:
-                    continue  # already measured (and verified) by check 3
+        key_np = np.asarray(key)
+        sorted_keys = np.sort(key_np)
+
+        def bitonic_rung(label, **kw):
+            """One oracle-verified timing of the bitonic kernel at a
+            non-default configuration: compile, verify keys AND payload
+            pairing against check 3's hoisted oracle arrays, then time.
+            Error-isolated per rung (a risky compile must not take down
+            the ladder); ONE body for both ladders so the oracle/timing
+            protocol cannot drift between them."""
+            try:
                 f = jax.jit(functools.partial(
-                    bitonic_sort, tile_rows=tr, interpret=False
+                    bitonic_sort, interpret=False, **kw
                 ))
                 t0 = time.perf_counter()
                 sk, (sp,) = f(key, (pay,))
@@ -173,20 +175,25 @@ def main() -> int:
                     np.array_equal(sk_np, sorted_keys)
                     and np.array_equal(key_np[sp_np], sk_np)
                 ):
-                    tiles[str(tr)] = {"error": "output failed oracle"}
-                    continue
+                    return {"error": "output failed oracle"}
                 ms = best_ms(lambda f=f: f(key, (pay,))[0])
-                tiles[str(tr)] = {
-                    "ms": round(ms, 3), "compile_s": round(compile_s, 1),
-                }
-                print(f"[tpu_checks] bitonic tile {tr}: {ms:.1f}ms",
+                print(f"[tpu_checks] bitonic {label}: {ms:.1f}ms",
                       file=sys.stderr, flush=True)
-            row = {"check": "bitonic_tile_ab", "n": n, "tiles": tiles}
-        except Exception as e:  # noqa: BLE001
-            row = {
-                "check": "bitonic_tile_ab",
-                "error": f"{type(e).__name__}: {e}"[:400],
-            }
+                return {"ms": round(ms, 3), "compile_s": round(compile_s, 1)}
+            except Exception as e:  # noqa: BLE001 - record the rung's loss
+                return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+        # 4. Tile sweep: where is the VMEM-residency/round-trip knee?
+        # The default tile reuses check 3's verified measurement — a
+        # flapping window should spend its seconds on the NEW points.
+        tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
+                                  "compile_s": 0.0,
+                                  "note": "from bitonic_sort_ab"}}
+        for tr in (128, 256, 512, 1024):
+            if tr == TILE_ROWS:
+                continue  # already measured (and verified) by check 3
+            tiles[str(tr)] = bitonic_rung(f"tile {tr}", tile_rows=tr)
+        row = {"check": "bitonic_tile_ab", "n": n, "tiles": tiles}
         print(json.dumps(row), flush=True)
         artifacts.record("tpu_check", row)
 
@@ -194,40 +201,17 @@ def main() -> int:
         # config.BITONIC_MAX_FUSED because UNLIMITED fusion crashed
         # Mosaic on 2026-07-31 — but that crash predates the int32-mask
         # rewrite, so this ladder measures whether the cap is still
-        # needed and what it costs.  Each rung error-isolated: the
-        # known-risky mf=0 compile must not take down the row.
+        # needed and what it costs.
         from locust_tpu.config import BITONIC_MAX_FUSED
 
         fused = {str(BITONIC_MAX_FUSED): {
-            "ms": row.get("tiles", {}).get(str(TILE_ROWS), {}).get("ms"),
+            "ms": tiles.get(str(TILE_ROWS), {}).get("ms"),
             "note": "config default, from bitonic_tile_ab",
-        } if "tiles" in row else {"note": "see bitonic_sort_ab"}}
+        }}
         for mf in (128, 0):
             if mf == BITONIC_MAX_FUSED:
                 continue
-            try:
-                f = jax.jit(functools.partial(
-                    bitonic_sort, max_fused=mf, interpret=False
-                ))
-                t0 = time.perf_counter()
-                sk, (sp,) = f(key, (pay,))
-                jax.block_until_ready(sk)
-                compile_s = time.perf_counter() - t0
-                sk_np, sp_np = np.asarray(sk), np.asarray(sp)
-                if not (
-                    np.array_equal(sk_np, sorted_keys)
-                    and np.array_equal(key_np[sp_np], sk_np)
-                ):
-                    fused[str(mf)] = {"error": "output failed oracle"}
-                    continue
-                ms = best_ms(lambda f=f: f(key, (pay,))[0])
-                fused[str(mf)] = {
-                    "ms": round(ms, 3), "compile_s": round(compile_s, 1),
-                }
-                print(f"[tpu_checks] bitonic max_fused={mf}: {ms:.1f}ms",
-                      file=sys.stderr, flush=True)
-            except Exception as e:  # noqa: BLE001 - record the rung's loss
-                fused[str(mf)] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            fused[str(mf)] = bitonic_rung(f"max_fused={mf}", max_fused=mf)
         row = {"check": "bitonic_fused_ab", "n": n, "fused": fused}
         print(json.dumps(row), flush=True)
         artifacts.record("tpu_check", row)
