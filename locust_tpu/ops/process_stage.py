@@ -7,8 +7,12 @@ emit slots to the tail (reference MapReduce/src/main.cu:411) then
 (reference README.md:72-80) and is the headline perf target (BASELINE.json).
 
 TPU-native formulations, selected by ``EngineConfig.sort_mode`` (also
-"hashp" = hash keys with payload-carry, "hash1" = one folded 32-bit key,
-"radix" = LSD counting sort; see the variant functions below):
+"hashp"/"hashp2"/"hashp1" = payload-carry at 3/2/1 hash key operands,
+"hash1" = one folded 32-bit key + gather, "radix" = LSD counting sort,
+"bitonic" = the hand-written Pallas VMEM-tiled network
+(ops/pallas/sort.py), and "hasht" = the fold-level SORT-FREE hash-table
+aggregation (ops/hash_table.py; this module serves its grouping-interface
+consumers via the hashp1 formulation); see the variant functions below):
 
 * **"lex"** — ONE multi-operand ``jax.lax.sort`` whose most-significant key
   is the inverted validity bit and whose remaining keys are the big-endian
