@@ -1,0 +1,79 @@
+"""scripts/farm_loop.py pure helpers — the unattended TPU-window farmer.
+
+The loop itself needs a tunnel; its decision logic (which evidence is
+fresh, which processes count as jobs, single-instance exclusion) is pure
+and suite-testable.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "farm_loop", os.path.join(REPO, "scripts", "farm_loop.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "LEDGER", str(tmp_path / "tpu_runs.jsonl"))
+    return mod
+
+
+def test_latest_ts_filters_kind_and_backend(monkeypatch, tmp_path):
+    m = _load(monkeypatch, tmp_path)
+    rows = [
+        {"kind": "bench", "backend": "tpu", "ts": 100.0},
+        {"kind": "bench", "backend": "cpu", "ts": 900.0},   # wrong backend
+        {"kind": "bench", "backend": "tpu", "ts": 300.0},
+        {"kind": "stream_scale", "backend": "tpu", "ts": 500.0},
+        {"malformed": True},
+    ]
+    with open(m.LEDGER, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        f.write("not json\n")
+    assert m.latest_ts("bench") == 300.0
+    assert m.latest_ts("stream_scale") == 500.0
+    assert m.latest_ts("nope") == 0.0
+
+
+def test_latest_ts_missing_ledger(monkeypatch, tmp_path):
+    m = _load(monkeypatch, tmp_path)
+    assert m.latest_ts("bench") == 0.0
+
+
+def test_job_detection_matches_argv_not_cmdline_mentions(monkeypatch, tmp_path):
+    """A process merely MENTIONING bench.py in a long argument (the
+    driver harness) must not count; a real `python .../bench.py` must."""
+    m = _load(monkeypatch, tmp_path)
+    # A sleeper whose ARGUMENT mentions the script name: not a job.
+    decoy = subprocess.Popen(
+        [sys.executable, "-c",
+         "import time,sys; time.sleep(30)", "--note=runs bench.py later"],
+    )
+    try:
+        time.sleep(0.3)
+        assert m.other_jobs_running() is False
+    finally:
+        decoy.kill()
+        decoy.wait()
+
+
+def test_single_instance_exclusion(monkeypatch, tmp_path):
+    """A second farm_loop must refuse to start while one is alive."""
+    m = _load(monkeypatch, tmp_path)
+    fake = tmp_path / "farm_loop.py"
+    fake.write_text("import time; time.sleep(30)\n")
+    p = subprocess.Popen([sys.executable, str(fake)])
+    try:
+        time.sleep(0.3)
+        assert p.pid in m._python_procs_running(("farm_loop.py",))
+    finally:
+        p.kill()
+        p.wait()
