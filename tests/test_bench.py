@@ -321,3 +321,32 @@ def test_bad_config_env_still_emits_one_json_line(tmp_path):
     row = json.loads(lines[0])
     assert "LOCUST_BITONIC_MAX_FUSED" in row["error"]
     assert out.returncode == 1
+
+
+def test_best_tpu_ab_row_picks_max_and_labels(tmp_path, monkeypatch):
+    """The CPU-fallback embed must surface the strongest committed
+    engine-level A/B measurement with its kind/setting, skipping errored
+    sides (they have no mb_s)."""
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu", "ts": 1.0,
+             "device": "TPU v5 lite",
+             "modes": {"hashp2": {"mb_s": 57.6},
+                       "bitonic": {"error": "MosaicError"}}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu", "ts": 2.0,
+             "device": "TPU v5 lite",
+             "blocks": {"65536": {"mb_s": 63.95}, "32768": {"mb_s": 57.4}}}
+        ) + "\n")
+    row = bench._best_tpu_ab_row()
+    assert row["value"] == 63.95
+    assert row["kind"] == "block_lines_ab"
+    assert row["setting"] == "65536"
+    assert row["vs_baseline"] == round(63.95 / bench.BASELINE_MB_S, 2)
+
+
+def test_best_tpu_ab_row_empty_ledger(tmp_path, monkeypatch):
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    assert bench._best_tpu_ab_row() is None
