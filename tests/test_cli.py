@@ -391,3 +391,19 @@ def test_cli_tfidf_matches_library(tmp_path, capsysbinary):
         assert abs(got[k] - want[k]) < 1e-4
     # tfidf --mesh is a loud unsupported error, not silence.
     assert cli.main(["tfidf", str(p), "--mesh"]) == 2
+
+
+def test_cli_stream_checkpoint_hasht(corpus_file, tmp_path, capsysbinary):
+    """--stream + --checkpoint-dir + the sort-free fold: snapshots of
+    hasht's slot-ordered tables must resume exactly through the CLI
+    path too (single-device analog of the rig's hasht_checkpoint)."""
+    ckpt = str(tmp_path / "ck")
+    args = [corpus_file, "--stream", "--checkpoint-dir", ckpt,
+            "--sort-mode", "hasht"] + _cfg_args()
+    rc = cli.main(args)
+    assert rc == 0
+    first = _parse_table(capsysbinary.readouterr().out)
+    assert first == dict(py_wordcount(CORPUS.splitlines(), 8))
+    rc = cli.main(args)
+    assert rc == 0
+    assert _parse_table(capsysbinary.readouterr().out) == first
