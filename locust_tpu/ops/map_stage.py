@@ -109,12 +109,19 @@ def tokenize_block(lines: jax.Array, cfg: EngineConfig) -> TokenizeResult:
 
     # Token end masking needs no end-index table: a token's bytes run until
     # its first delimiter (NUL pad included in the delimiter set), so the
-    # running all-non-delimiter product over the gathered window IS the key
+    # running all-non-delimiter AND over the gathered window IS the key
     # mask.  Tokens longer than key_w truncate, matching the reference's
-    # 30-byte key field (KeyValue.h:15).
-    live = jnp.cumprod(
-        (~bytes_ops.delimiter_mask(gathered)).astype(jnp.int32), axis=-1
-    ).astype(bool)                                          # [L, E, K]
+    # 30-byte key field (KeyValue.h:15).  The prefix-AND runs as log2(K)
+    # shifted ANDs rather than a cumprod: XLA lowers cumprod to a serial
+    # scan that costs ~2x the whole rest of the tail on CPU (measured
+    # 8.9ms vs 5.0ms at [8192, 17, 16]), while K is a tiny static width.
+    live = ~bytes_ops.delimiter_mask(gathered)              # [L, E, K]
+    shift = 1
+    while shift < key_w:
+        live = live & jnp.concatenate(
+            [jnp.ones_like(live[..., :shift]), live[..., :-shift]], axis=-1
+        )
+        shift *= 2
     keys = jnp.where(live & valid[..., None], gathered, jnp.uint8(0))
 
     overflow = jnp.sum(jnp.maximum(ntok - emits, 0))
