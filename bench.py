@@ -208,15 +208,26 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
     def caps_match(row: dict) -> bool:
         """Joint-measurement rule for the capacity axes: the row's
         recorded caps (older rows predate the field = engine defaults)
-        must equal the caps this bench run assembles."""
+        must equal the caps this bench run assembles, and the row's
+        corpus size must match the size THIS bench runs at — the
+        farm loop's second-sourcing sweeps (8MB / 64MB, VERDICT r4 next
+        #9) append to the same ledger kinds, and an off-shape winner
+        must not steer the 32MB headline config (code review, r5)."""
         if caps is None:
             return True
         row_caps = row.get("caps") or {"key_width": 32, "emits_per_line": 20}
-        return (
-            int(row_caps.get("key_width", 32)) == caps["key_width"]
-            and int(row_caps.get("emits_per_line", 20))
-            == caps["emits_per_line"]
-        )
+        if (
+            int(row_caps.get("key_width", 32)) != caps["key_width"]
+            or int(row_caps.get("emits_per_line", 20))
+            != caps["emits_per_line"]
+        ):
+            return False
+        row_mb = row.get("corpus_mb")
+        if isinstance(row_mb, (int, float)) and row_mb > 0:
+            target_mb = TARGET_BYTES / 1e6
+            if abs(float(row_mb) - target_mb) > 0.25 * target_mb:
+                return False
+        return True  # legacy rows without corpus_mb were headline-shaped
 
     def side_mb(side) -> float:
         """MB/s of one A/B side; a malformed/errored side (null, missing
@@ -225,15 +236,59 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
             return float(side["mb_s"])
         return -1.0
 
+    def lossless_sides(sides: dict) -> dict:
+        """Drop A/B sides that measured a semantically DIFFERENT run
+        (VERDICT r4 weak #5 / next #8): nonzero overflow_tokens, or
+        fewer distinct keys than the best side in the same row — losing
+        tokens or truncating the table can only shrink distinct, so the
+        within-row maximum is the exact anchor.  A faster-but-lossy side
+        (e.g. an emits cap that drops tokens) must never steer the
+        headline config; sides without the fields are kept (older rows
+        predate them, and mb_s-only sides carry no loss signal).
+        Errored/malformed sides are dropped here too so max() below can
+        only ever pick a real, lossless measurement."""
+        real = {
+            k: v
+            for k, v in sides.items()
+            if isinstance(v, dict)
+            and isinstance(v.get("mb_s"), (int, float))
+        }
+        distincts = [
+            int(v["distinct"])
+            for v in real.values()
+            if isinstance(v.get("distinct"), int)
+        ]
+        anchor = max(distincts) if distincts else None
+        out = {}
+        for k, v in real.items():
+            if int(v.get("overflow_tokens") or 0) > 0:
+                continue
+            d = v.get("distinct")
+            if anchor is not None and isinstance(d, int) and d < anchor:
+                continue
+            out[k] = v
+        return out
+
     # Evidence must never break a run (same stance as utils/artifacts.py),
     # and one malformed row must not revert knobs validly adopted from
     # OTHER kinds (ADVICE r3): each kind is guarded independently; the
     # outer except stays as a last-resort backstop.
+    def newest_matching(rows, extra=None):
+        """Newest row passing the joint-measurement rules — NOT just
+        rows[-1]: the farm's second-sourcing sweeps (8MB/64MB) append
+        off-shape rows to the same kinds, and an off-shape LAST row must
+        skip back to the newest headline-shaped one, not knock the whole
+        kind out (code review, r5)."""
+        for r in reversed(rows):
+            if caps_match(r) and (extra is None or extra(r)):
+                return r
+        return None
+
     try:
         try:
-            ab = _tpu_rows("engine_sort_mode_ab")
-            if ab and caps_match(ab[-1]):
-                modes = ab[-1].get("modes", {})
+            ab_row = newest_matching(_tpu_rows("engine_sort_mode_ab"))
+            if ab_row is not None:
+                modes = lossless_sides(ab_row.get("modes", {}))
                 best = max(modes, key=lambda m: side_mb(modes.get(m)), default=None)
                 if best is not None and side_mb(modes.get(best)) > 0.0:
                     from locust_tpu.config import SORT_MODES
@@ -255,24 +310,22 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
         # predate the field and swept the historical default "hash"), so
         # the joint configuration is always one a window actually ran.
         try:
-            bl = _tpu_rows("block_lines_ab")
-            if bl:
-                row = bl[-1]
-                blocks = row.get("blocks", {})
-                if (
-                    caps_match(row)
-                    and row.get("sort_mode", "hash") == out["sort_mode"]
-                ):
-                    best = max(
-                        blocks, key=lambda b: side_mb(blocks.get(b)), default=None
+            row = newest_matching(
+                _tpu_rows("block_lines_ab"),
+                extra=lambda r: r.get("sort_mode", "hash") == out["sort_mode"],
+            )
+            if row is not None:
+                blocks = lossless_sides(row.get("blocks") or {})
+                best = max(
+                    blocks, key=lambda b: side_mb(blocks.get(b)), default=None
+                )
+                if best is not None and side_mb(blocks.get(best)) > 0.0:
+                    out["block_lines"] = int(best)
+                    print(
+                        f"[bench] evidence-tuned block_lines={best} "
+                        f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
+                        file=sys.stderr,
                     )
-                    if best is not None and side_mb(blocks.get(best)) > 0.0:
-                        out["block_lines"] = int(best)
-                        print(
-                            f"[bench] evidence-tuned block_lines={best} "
-                            f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
-                            file=sys.stderr,
-                        )
         except Exception as e:  # noqa: BLE001 - skip this kind only
             print(
                 f"[bench] block-lines evidence skipped ({type(e).__name__}: {e})",
@@ -283,18 +336,18 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
         # same joint-measurement rule as above.  A side that errored has
         # no "mb_s" key and loses.
         try:
-            pa = _tpu_rows("engine_pallas_ab")
-            if pa:
-                row = pa[-1]
-                joint = (
-                    caps_match(row)
-                    and row.get("sort_mode", "hash") == out["sort_mode"]
-                    and int(row.get("block_lines", 32768)) == out["block_lines"]
-                )
-                sides = row.get("pallas", {})
+            row = newest_matching(
+                _tpu_rows("engine_pallas_ab"),
+                extra=lambda r: (
+                    r.get("sort_mode", "hash") == out["sort_mode"]
+                    and int(r.get("block_lines", 32768)) == out["block_lines"]
+                ),
+            )
+            if row is not None:
+                sides = lossless_sides(row.get("pallas") or {})
                 on = side_mb(sides.get("True"))
                 off = side_mb(sides.get("False"))
-                if joint and on > off > 0.0:
+                if on > off > 0.0:
                     out["use_pallas"] = True
                     print(
                         f"[bench] evidence-tuned use_pallas=True "

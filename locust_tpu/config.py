@@ -36,13 +36,15 @@ def default_sort_mode(backend: str) -> str:
 
     CPU: "hasht" wins the driver-policy grid decisively
     (artifacts/bench_block_cpu_r4.jsonl: 7.94 vs hash1's 5.14 MB/s) and
-    is soak-proven (260-case battery).  TPU: payload-carry "hashp" per
-    the committed on-hardware variant row (artifacts/tpu_runs.jsonl
-    sort_variants); bench.py's evidence tuning supersedes this with the
-    latest engine-level A/B row at bench time.  Anything else: the
-    portable "hash".
+    is soak-proven (260-case battery).  TPU: "hashp2" per the committed
+    engine-level on-hardware A/B (artifacts/tpu_runs.jsonl
+    engine_sort_mode_ab 2026-07-31: 57.6 vs hashp's 56.9 MB/s — within
+    single-window noise, so the static default simply follows the
+    committed measurement; bench.py's evidence tuning supersedes this
+    with the latest engine-level A/B row at bench time).  Anything
+    else: the portable "hash".
     """
-    return {"cpu": "hasht", "tpu": "hashp"}.get(backend, "hash")
+    return {"cpu": "hasht", "tpu": "hashp2"}.get(backend, "hash")
 
 # Newline bytes also terminate tokens: the reference tokenizes line-by-line so
 # a '\n' never reaches strtok; our padded line tensors strip newlines at ingest.

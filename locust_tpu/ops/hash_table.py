@@ -11,7 +11,11 @@ open-addressed hash table with XLA scatters:
        hash (the winner per slot is deterministic: smallest folded);
     2. winners whose slot is EMPTY write their full key lanes
        (same-key writers write identical bytes, so duplicate-index
-       write order cannot matter);
+       write order cannot matter; two DISTINCT keys can both "win" only
+       on a 31-bit folded-hash collision, and XLA does not promise the
+       duplicate-index row write is atomic — the slot could then hold an
+       interleaved chimera matching neither writer, so step 3's matched
+       flag is what ultimately marks a slot used);
     3. every unresolved row gathers its slot's stored lanes and compares
        ALL lanes — a row is resolved only by an exact full-key match, so
        hash collisions can never merge distinct keys (same invariant as
@@ -95,6 +99,16 @@ def hash_aggregate(
 
     stored_lanes = jnp.zeros((T + 1, n_lanes), jnp.uint32)  # row T = dump
     acc = jnp.full((T + 1,), _COMBINE_INIT[combine], jnp.int32)
+    # A slot counts as used only once some row has FULL-KEY-matched it.
+    # Written-but-never-matched slots are possible in exactly one case:
+    # two distinct keys collide on the 31-bit folded hash, both win the
+    # same empty slot in the same round, and the duplicate-index row
+    # write interleaves per element (XLA leaves this unspecified) — the
+    # stored bytes then match neither writer.  Without this flag such a
+    # slot would surface as a phantom output row holding the combine
+    # init; with it, the slot is excluded and both writers resolve via
+    # later probes or the exact fallback ladder.
+    matched_slot = jnp.zeros((T + 1,), bool)
 
     for p in range(probes):
         slot = ((h1 + jnp.uint32(p) * step) % jnp.uint32(T)).astype(jnp.int32)
@@ -117,6 +131,7 @@ def hash_aggregate(
         )
         # 4. Combine resolved values into the slot (dump row otherwise).
         vslot = jnp.where(match, slot, T)
+        matched_slot = matched_slot.at[vslot].set(True, mode="drop")
         if combine == "sum":
             acc = acc.at[vslot].add(values, mode="drop")
         elif combine == "min":
@@ -125,7 +140,7 @@ def hash_aggregate(
             acc = acc.at[vslot].max(values, mode="drop")
         unresolved = unresolved & ~match
 
-    used = stored_lanes[:T, 0] != 0
+    used = (stored_lanes[:T, 0] != 0) & matched_slot[:T]
     table = KVBatch(
         key_lanes=stored_lanes[:T],
         values=jnp.where(used, acc[:T], 0),

@@ -45,6 +45,20 @@ from locust_tpu.core.kv import KVBatch
 
 logger = logging.getLogger("locust_tpu")
 _warned_bitonic_fallback = False
+_warned_bitonic_interpret = False
+
+# Largest padded element count the INTERPRET-mode bitonic kernel (the
+# off-TPU test vehicle) is allowed to trace: the interpreter re-traces
+# every fused VMEM launch into one XLA program, and at production shapes
+# under a mesh program that blows up the CPU compiler (observed: SIGSEGV
+# inside XLA at full-hamlet mesh-merge shapes, 8 shards x 2^18 rows x 10
+# operands).  Above the cap, off-TPU callers get the stock formulation
+# with a loud one-time notice; on TPU the real Mosaic kernel always runs.
+import os as _os
+
+BITONIC_INTERPRET_MAX: int = int(
+    _os.environ.get("LOCUST_BITONIC_INTERPRET_MAX", 1 << 16)
+)
 
 
 def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
@@ -234,18 +248,28 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
     payload (ops/pallas/sort.py): "hash1"'s single 31-bit-hash+validity
     operand with "hashp"'s payload carriage, but the tile-local compare
     passes run in VMEM instead of streaming HBM.  Interpret mode engages
-    automatically off-TPU (slow; CI uses small shapes).
+    automatically off-TPU (slow; CI uses small shapes) and is CAPPED at
+    BITONIC_INTERPRET_MAX padded elements — beyond it, off-TPU callers
+    get the stock formulation with a one-time notice (the interpreter's
+    re-trace crashes the CPU XLA compiler at production mesh shapes);
+    on TPU the Mosaic kernel always runs.
 
-    Inside a ``shard_map`` manual trace the kernel cannot currently
-    trace: ``jnp.roll`` drops the varying-manual-axes type inside the
-    kernel body and ``check_vma`` rejects the mixed comparison (jax
-    issue; a ``pvary`` re-attach fails again in the interpret lowering's
-    physical-type re-trace).  There the mode falls back to the
-    semantically IDENTICAL stock formulation — same single folded-key
-    operand, same payload carriage via ``lax.sort`` — so mesh engines
-    accept sort_mode="bitonic" everywhere and the hand-written kernel
-    serves the single-device path (the headline bench's) until the jax
-    fix lands."""
+    Inside a ``shard_map(check_vma=True)`` manual trace the kernel
+    cannot trace: jax's vma machinery breaks inside the pallas
+    interpret re-trace (a mixed-vma ``lt``; a ``pvary`` re-attach fails
+    again in the physical-type re-trace).  BOTH mesh engines therefore
+    pass ``check_vma=False`` on their round step when this mode is
+    configured (shuffle.py and hierarchical.py engine ctors;
+    hierarchical's sync/combine shard_maps are check_vma=False for
+    their own all_gather-replication reason), which removes vma types
+    entirely and the kernel RUNS — pinned by
+    tests/test_distributed.py::test_mesh_engines_run_bitonic_kernel.
+    This fallback remains only for third-party shard_map sites that
+    keep check_vma=True: there the mode serves the semantically
+    IDENTICAL stock formulation — same single folded-key operand, same
+    payload carriage via ``lax.sort`` — with a loud one-time warning so
+    no A/B can silently time the fallback believing it measured the
+    kernel."""
     lanes, values, valid = batch.key_lanes, batch.values, batch.valid
     n_lanes = lanes.shape[-1]
     folded = _folded_key(batch)
@@ -264,10 +288,13 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
         if not _warned_bitonic_fallback:
             _warned_bitonic_fallback = True
             logger.warning(
-                "sort_mode='bitonic' inside shard_map: Pallas kernel "
-                "cannot trace under check_vma (jnp.roll drops vma); "
-                "using the equivalent stock lax.sort formulation — mesh "
-                "timings do NOT measure the hand-written kernel"
+                "sort_mode='bitonic' inside shard_map(check_vma=True): "
+                "jax's vma machinery cannot trace the Pallas kernel "
+                "(mixed-vma compare in the pallas interpret re-trace); "
+                "using the equivalent stock lax.sort formulation — these "
+                "timings do NOT measure the hand-written kernel.  The "
+                "built-in mesh engines avoid this by passing "
+                "check_vma=False for this mode"
             )
         # The stock formulation of the same sort IS mode "hashp1" —
         # delegate so "semantically identical" stays true by construction.
@@ -275,6 +302,23 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
     from locust_tpu.ops.pallas.sort import bitonic_sort
 
     interpret = jax.default_backend() != "tpu"
+    n_pad = max(1 << 10, 1 << max(batch.size - 1, 1).bit_length())
+    if interpret and n_pad > BITONIC_INTERPRET_MAX:
+        # Interpret mode is the off-TPU TEST vehicle; at production
+        # shapes its re-trace of every fused launch crashes the CPU
+        # XLA compiler (SIGSEGV at mesh-merge shapes).  Off-TPU big
+        # sorts take the stock formulation — loudly, so no CPU timing
+        # can be mistaken for a kernel measurement.
+        global _warned_bitonic_interpret
+        if not _warned_bitonic_interpret:
+            _warned_bitonic_interpret = True
+            logger.warning(
+                "sort_mode='bitonic' off-TPU at %d rows (> %d): interpret-"
+                "mode kernel skipped; using the equivalent stock lax.sort "
+                "formulation (LOCUST_BITONIC_INTERPRET_MAX overrides)",
+                batch.size, BITONIC_INTERPRET_MAX,
+            )
+        return _hashp1_sort(batch)
     key, pays = bitonic_sort(
         folded,
         tuple(lanes[:, i] for i in range(n_lanes)) + (values,),

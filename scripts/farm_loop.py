@@ -30,6 +30,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LEDGER = os.path.join(REPO, "artifacts", "tpu_runs.jsonl")
+PROFILES = os.path.join(REPO, "artifacts", "profiles")
 
 
 def log(msg: str) -> None:
@@ -128,24 +129,29 @@ def run(cmd: list[str], timeout: float, env: dict | None = None) -> int:
 
 
 def commit_ledger() -> None:
-    """Commit ONLY the evidence ledger; retry briefly on index-lock races
-    with the interactive session's own commits."""
-    diff = subprocess.run(
-        ["git", "diff", "--quiet", "HEAD", "--", LEDGER], cwd=REPO
+    """Commit ONLY the evidence paths (ledger + COMPRESSED xplane
+    captures); retry briefly on index-lock races with the interactive
+    session's own commits.  Raw capture trees (a killed phase_profile
+    leaves its multi-MB prof_dir behind — the gzip+cleanup only runs on
+    success) are never staged: only the *.xplane.pb.gz files the
+    profiler phase finalizes."""
+    import glob
+
+    paths = [LEDGER] + sorted(
+        glob.glob(os.path.join(PROFILES, "*.xplane.pb.gz"))
     )
-    if diff.returncode == 0:
-        untracked = subprocess.run(
-            ["git", "ls-files", "--error-unmatch", LEDGER],
-            cwd=REPO, capture_output=True,
-        )
-        if untracked.returncode == 0:
-            return  # tracked and unchanged
+    diff = subprocess.run(
+        ["git", "status", "--porcelain", "--"] + paths,
+        cwd=REPO, capture_output=True, text=True,
+    )
+    if not diff.stdout.strip():
+        return  # tracked and unchanged, nothing new
     for _ in range(5):
-        add = subprocess.run(["git", "add", LEDGER], cwd=REPO,
+        add = subprocess.run(["git", "add", "--"] + paths, cwd=REPO,
                              capture_output=True, text=True)
         c = subprocess.run(
             ["git", "commit", "-m",
-             "Ledger: TPU window evidence rows (farm loop)", "--", LEDGER],
+             "Ledger: TPU window evidence rows (farm loop)", "--"] + paths,
             cwd=REPO, capture_output=True, text=True,
         )
         if c.returncode == 0:
@@ -158,21 +164,53 @@ def commit_ledger() -> None:
         return
 
 
+def next_ab_bytes() -> int:
+    """Second-source the sort-mode A/B across corpus sizes (VERDICT r4
+    next #9): the first complete post-hasht row anchors the 32MB
+    headline shape; later windows re-run at 8MB then 64MB so the
+    hashp2/hasht ordering is confirmed (or refuted) at different shapes
+    instead of resting on one window's ~1% margin."""
+    done_mb = set()
+    for r in ledger_rows():
+        if (
+            r.get("kind") == "engine_sort_mode_ab"
+            and r.get("backend") == "tpu"
+            and isinstance(r.get("modes"), dict)
+            # Only COMPLETE rows that measured hasht retire a size:
+            # hasht runs FIRST in the A/B, so a window that dies after
+            # one mode leaves a partial hasht-only row — treating that
+            # as "answered" would skip the hashp2 comparison the row
+            # exists for (code review, r5).  Older rows predate hasht's
+            # priority slot and don't answer the question either way.
+            and not r.get("partial")
+            and isinstance(r["modes"].get("hasht"), dict)
+            and "mb_s" in r["modes"]["hasht"]
+        ):
+            done_mb.add(round(float(r.get("corpus_mb") or 0)))
+    for mb, nbytes in ((34, 32 << 20), (8, 8 << 20), (67, 64 << 20)):
+        if mb not in done_mb:
+            return nbytes
+    return 32 << 20
+
+
 def harvest_window() -> None:
     """One open window: bench -> sweep -> (stream) -> commit."""
     # 1. Headline bench, unless a TPU bench row landed within the hour.
     if time.time() - latest_ts("bench") > 3600:
         run([sys.executable, "bench.py"], timeout=1300)
         commit_ledger()
-    # 2. Full decision sweep (bitonic verdict, sort-mode/block/pallas
-    #    A/Bs, Pallas check battery, stage parity, caps A/Bs).  The
-    #    stream phase rides along until a stream_scale row has actually
-    #    landed in the ledger — derived from the ledger each window, so a
-    #    sweep that dies before the stream phase retries it next window.
+    # 2. Full decision sweep (hasht + bitonic verdicts, sort-mode/block/
+    #    pallas A/Bs, profiler capture, stage device-time decomposition,
+    #    Pallas check battery, stage parity, caps A/Bs).  The stream
+    #    phase rides along until a stream_scale row has actually landed
+    #    in the ledger — derived from the ledger each window, so a sweep
+    #    that dies before the stream phase retries it next window.
     env = dict(os.environ)
     if not latest_ts("stream_scale"):
         env["LOCUST_OPP_STREAM_MB"] = os.environ.get(
             "LOCUST_FARM_STREAM_MB", "512")
+    env["LOCUST_OPP_AB_BYTES"] = os.environ.get(
+        "LOCUST_OPP_AB_BYTES", str(next_ab_bytes()))
     run([sys.executable, os.path.join("scripts", "tpu_opportunistic.py")],
         timeout=2400, env=env)
     commit_ledger()

@@ -289,6 +289,121 @@ def test_evidence_tuning_guards_each_kind_independently(
                      "use_pallas": False}
 
 
+def test_evidence_tuning_rejects_off_shape_corpus(tmp_path, monkeypatch, capsys):
+    """The farm loop's second-sourcing sweeps record A/B rows at 8MB /
+    64MB into the same ledger kinds; a row measured at a different
+    corpus size than the headline bench runs must not steer its config
+    (code review, r5).  Legacy rows without corpus_mb still count."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    caps = {"key_width": 32, "emits_per_line": 20}
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "corpus_mb": 8.4,  # second-source shape, not the headline
+             "modes": {"hashp": {"mb_s": 70.0}}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static, caps) == static
+    # Headline-shaped row (33.6MB vs TARGET_BYTES 33.55MB): adopted.
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "corpus_mb": 33.6,
+             "modes": {"hashp": {"mb_s": 70.0}}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static, caps)[
+        "sort_mode"] == "hashp"
+    # Legacy row, no corpus_mb field: treated as headline-shaped.
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hash1": {"mb_s": 70.0}}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static, caps)[
+        "sort_mode"] == "hash1"
+
+
+def test_evidence_tuning_reaches_past_off_shape_rows(
+    tmp_path, monkeypatch, capsys
+):
+    """An off-shape (second-source) row landing LAST must not knock the
+    kind out: tuning skips back to the newest row passing the joint
+    rules (code review, r5)."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    caps = {"key_width": 32, "emits_per_line": 20}
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        # Valid headline-shaped rows first...
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "corpus_mb": 33.6, "modes": {"hashp2": {"mb_s": 57.6}}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu", "corpus_mb": 33.6,
+             "sort_mode": "hashp2",
+             "blocks": {"32768": {"mb_s": 55.0}, "65536": {"mb_s": 64.0}}}
+        ) + "\n")
+        # ...then an 8MB second-source sweep appends off-shape rows LAST.
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "corpus_mb": 8.4, "modes": {"hasht": {"mb_s": 70.0}}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu", "corpus_mb": 8.4,
+             "sort_mode": "hasht", "blocks": {"16384": {"mb_s": 71.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static, caps)
+    assert tuned["sort_mode"] == "hashp2"
+    assert tuned["block_lines"] == 65536
+
+
+def test_evidence_tuning_rejects_lossy_sides(tmp_path, monkeypatch, capsys):
+    """A faster-but-lossy A/B side must never steer the headline config
+    (VERDICT r4 next #8): nonzero overflow_tokens, or fewer distinct
+    keys than the best side of the same row (= dropped tokens or a
+    truncated table), disqualify a side; the best LOSSLESS side wins
+    instead."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        # "hashp" is fastest but dropped tokens (overflow); "hash1" is
+        # second-fastest but its table lost distinct keys; "hashp2" is
+        # the best exact side and must be the one adopted.
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {
+                 "hashp": {"mb_s": 60.0, "overflow_tokens": 275802,
+                           "distinct": 5608},
+                 "hash1": {"mb_s": 55.0, "overflow_tokens": 0,
+                           "distinct": 5476},
+                 "hashp2": {"mb_s": 50.0, "overflow_tokens": 0,
+                            "distinct": 5608},
+                 "radix": {"mb_s": 10.0, "distinct": 5608},
+             }}
+        ) + "\n")
+        # A lossy pallas=True side must not flip the flag either.
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hashp2", "block_lines": 32768,
+             "pallas": {
+                 "True": {"mb_s": 70.0, "distinct": 5000},
+                 "False": {"mb_s": 50.0, "distinct": 5608},
+             }}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned["sort_mode"] == "hashp2"
+    assert tuned["use_pallas"] is False
+
+    # All sides lossy -> nothing adoptable -> static default survives.
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hashp": {"mb_s": 60.0, "overflow_tokens": 7,
+                                 "distinct": 5608}}}
+        ) + "\n")
+    assert bench._evidence_tuned_tpu_defaults(static) == static
+
+
 def test_error_payload_shape():
     row = bench.error_payload("boom")
     assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}

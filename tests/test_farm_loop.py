@@ -77,3 +77,44 @@ def test_single_instance_exclusion(monkeypatch, tmp_path):
     finally:
         p.kill()
         p.wait()
+
+
+def test_next_ab_bytes_second_source_schedule(monkeypatch, tmp_path):
+    """Corpus-size second-sourcing (VERDICT r4 next #9): the 32MB
+    headline shape first; once a COMPLETE row with a measured hasht
+    exists there, 8MB, then 64MB; a partial hasht-only row (window died
+    after the first mode) must NOT retire a size (code review, r5)."""
+    m = _load(monkeypatch, tmp_path)
+    assert m.next_ab_bytes() == 32 << 20  # empty ledger
+
+    def write(rows):
+        with open(m.LEDGER, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    # Partial row (hasht only, window died): 32MB NOT retired.
+    partial = {"kind": "engine_sort_mode_ab", "backend": "tpu",
+               "corpus_mb": 33.6, "partial": True,
+               "modes": {"hasht": {"mb_s": 50.0}}}
+    write([partial])
+    assert m.next_ab_bytes() == 32 << 20
+
+    # Complete row pre-hasht (legacy, no hasht side): not retired either.
+    legacy = {"kind": "engine_sort_mode_ab", "backend": "tpu",
+              "corpus_mb": 33.6, "partial": False,
+              "modes": {"hashp2": {"mb_s": 57.6}}}
+    write([legacy])
+    assert m.next_ab_bytes() == 32 << 20
+
+    # Complete row with hasht measured: advance to 8MB, then 64MB.
+    done32 = {"kind": "engine_sort_mode_ab", "backend": "tpu",
+              "corpus_mb": 33.6, "partial": False,
+              "modes": {"hasht": {"mb_s": 50.0}, "hashp2": {"mb_s": 57.6}}}
+    write([done32])
+    assert m.next_ab_bytes() == 8 << 20
+    done8 = dict(done32, corpus_mb=8.4)
+    write([done32, done8])
+    assert m.next_ab_bytes() == 64 << 20
+    done64 = dict(done32, corpus_mb=67.1)
+    write([done32, done8, done64])
+    assert m.next_ab_bytes() == 32 << 20  # full cycle -> re-anchor headline

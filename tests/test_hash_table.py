@@ -116,9 +116,12 @@ def test_distinct_keys_sharing_slots_never_merge():
 def test_engine_hasht_oracle_exact(n_lines):
     """End-to-end WordCount with sort_mode='hasht' equals the pure-Python
     oracle — the same bar every sort mode passes (test_pipeline)."""
-    lines = open("/root/reference/hamlet.txt", "rb").read().splitlines()[
-        :n_lines
-    ]
+    import os
+
+    path = "/root/reference/hamlet.txt"
+    if not os.path.exists(path):
+        pytest.skip("reference corpus not mounted")
+    lines = open(path, "rb").read().splitlines()[:n_lines]
     eng = MapReduceEngine(EngineConfig(block_lines=512, sort_mode="hasht"))
     res = eng.run_lines(lines)
     got = dict(res.to_host_pairs())
@@ -192,6 +195,34 @@ def test_lane0_zero_rows_return_as_unresolved():
     table, used, unresolved = hash_aggregate(batch, 16)
     assert list(np.asarray(unresolved)) == [True, True]
     assert int(used) == 0
+
+
+def test_degenerate_hash_exact_and_no_phantom_slots(monkeypatch):
+    """Total hash collision (every key returns the same (h1, h2)): all
+    rows fight for ONE slot per round, so at most `probes` keys resolve
+    and everything else must surface as unresolved.  Exercises the
+    matched-slot guard: a slot counts as used only after a full-key
+    match, so resolved keys are exact and no phantom (written-but-never-
+    matched) slot can surface in the table."""
+    from locust_tpu.core import packing as packing_mod
+
+    real = packing_mod.hash_pair
+
+    def degenerate(lanes):
+        h1, h2 = real(lanes)
+        return jnp.full_like(h1, 123457), jnp.full_like(h2, 7)
+
+    monkeypatch.setattr(packing_mod, "hash_pair", degenerate)
+    words = [b"w%d" % (i % 25) for i in range(200)]
+    table, used, unresolved = hash_aggregate(_batch(words), 64)
+    got = _table_dict(table)
+    oracle = collections.Counter(words)
+    assert len(got) == int(used) <= 4  # one slot resolvable per probe round
+    for k, v in got.items():
+        assert v == oracle[k], f"{k!r} wrong under total collision"
+    # Accounting: every valid row is either in a resolved key's total or
+    # returned unresolved — nothing vanishes into a phantom slot.
+    assert sum(got.values()) + int(np.asarray(unresolved).sum()) == len(words)
 
 
 def test_debug_checks_accept_hasht_tables(monkeypatch):
