@@ -214,6 +214,46 @@ def variant_j(lanes, values, valid):
     )
 
 
+def variant_k(lanes, values, valid):
+    """MXU histogram probe: scatter-add spelled as a one-hot matmul.
+
+    The backup primitive for a sort-free Process stage if variant J
+    shows XLA's duplicate-index scatter is serialized on TPU.  Decompose
+    the bucket id as ``hi * 512 + lo`` and accumulate
+    ``counts2d[h, l] = sum_n value_n * onehot_hi[n, h] * onehot_lo[n, l]``
+    — ONE ``[128, n] x [n, 512]`` bf16 contraction on the MXU (~47
+    GMACs at sweep shape ~ 0.5 ms of v5e MXU time; one-hot traffic
+    ~0.9 GB vs the sort's ~14 GB model).  bf16 one-hot entries and
+    sub-256 values are exact; f32 accumulation is exact below 2^24 per
+    bucket.  Like J this measures the PRIMITIVE — an engine mode still
+    needs the representative-key claim/verify ladder for exactness —
+    and adoption only ever follows an engine-level A/B.
+    """
+    import jax.numpy as jnp
+
+    from locust_tpu.core import packing
+
+    T_HI, T_LO = 128, 512  # 65536 buckets as a [128, 512] grid
+    h1, h2 = packing.hash_pair(lanes)
+    bucket = ((h1 ^ h2) & jnp.uint32(T_HI * T_LO - 1)).astype(jnp.int32)
+    hi = bucket >> 9
+    lo = bucket & (T_LO - 1)
+    w = jnp.where(valid, values, 0).astype(jnp.bfloat16)
+    oh_hi = (
+        hi[:, None] == jnp.arange(T_HI, dtype=jnp.int32)[None, :]
+    ).astype(jnp.bfloat16)
+    oh_lo = (
+        lo[:, None] == jnp.arange(T_LO, dtype=jnp.int32)[None, :]
+    ).astype(jnp.bfloat16)
+    counts2d = jnp.einsum(
+        "nh,nl->hl",
+        oh_hi * w[:, None],
+        oh_lo,
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.sum(counts2d).astype(jnp.uint32)
+
+
 VARIANTS = [
     ("A_lex9", variant_a),
     ("B_hash3_gather", variant_b),
@@ -225,6 +265,7 @@ VARIANTS = [
     ("H_bitonic_pallas", variant_h),
     ("I_hash1_payload", variant_i),
     ("J_scatter_agg", variant_j),
+    ("K_mxu_hist", variant_k),
 ]
 
 

@@ -39,10 +39,11 @@ def main() -> int:
     # Priority order (a short window should answer the open question
     # first): J = the hasht scatter primitive (VERDICT r4 next #2: is the
     # .at[].add serialized on TPU, the single biggest unknown on the
-    # headline), H = the Pallas bitonic kernel, C = the payload-carry
-    # incumbent, then the rest; radix (E/F) last — already measured
-    # losers (2.5-3x), only re-timed if the window is generous.
-    env["LOCUST_SORT_VARIANTS"] = "J,H,I,G,C,B,D,E,F"
+    # headline), K = the MXU-histogram backup for the same role, H = the
+    # Pallas bitonic kernel, C = the payload-carry incumbent, then the
+    # rest; radix (E/F) last — already measured losers (2.5-3x), only
+    # re-timed if the window is generous.
+    env["LOCUST_SORT_VARIANTS"] = "J,K,H,I,G,C,B,D,E,F"
     env["N"] = str(65536 + 32768 * 20)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_sort_variants.py"),
