@@ -292,9 +292,11 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
                 "jax's vma machinery cannot trace the Pallas kernel "
                 "(mixed-vma compare in the pallas interpret re-trace); "
                 "using the equivalent stock lax.sort formulation — these "
-                "timings do NOT measure the hand-written kernel.  The "
-                "built-in mesh engines avoid this by passing "
-                "check_vma=False for this mode"
+                "timings do NOT measure the hand-written kernel.  On TPU "
+                "the built-in mesh engines avoid this by passing "
+                "check_vma=False for this mode (off-TPU they keep the "
+                "check: the interpret kernel inside a mesh program can "
+                "crash XLA's CPU compiler)"
             )
         # The stock formulation of the same sort IS mode "hashp1" —
         # delegate so "semantically identical" stays true by construction.

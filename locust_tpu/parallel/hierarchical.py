@@ -169,18 +169,23 @@ class HierarchicalMapReduce:
         # replicated within a slice but VARIES across slices — out_spec
         # P(slice) gives the host a [n_slices * 6] stack to fold at sync
         # time.  This keeps the round path free of cross-slice collectives.
-        # check_vma off for sort_mode="bitonic", like the flat engine
-        # (shuffle.py ctor): jax's vma machinery cannot trace the Pallas
-        # kernel, and with the check on, the round step would silently
-        # measure the stock-sort fallback instead of the hand-written
-        # kernel (VERDICT r4 next #7).
+        # check_vma off for sort_mode="bitonic" ON TPU, like the flat
+        # engine (shuffle.py ctor, incl. the rationale for the TPU-only
+        # condition: the off-TPU interpret kernel inside a mesh program
+        # segfaults XLA's CPU compiler): jax's vma machinery cannot
+        # trace the Pallas kernel, and with the check on, the round step
+        # would silently measure the stock-sort fallback instead of the
+        # hand-written kernel (VERDICT r4 next #7).
         self._step = jax.jit(
             jax.shard_map(
                 local_step,
                 mesh=mesh,
                 in_specs=(P(both), kv_spec_2d, kv_spec_2d),
                 out_specs=(kv_spec_2d, kv_spec_2d, P(slice_axis)),
-                check_vma=cfg.sort_mode != "bitonic",
+                check_vma=not (
+                    cfg.sort_mode == "bitonic"
+                    and jax.default_backend() == "tpu"
+                ),
             )
         )
         # Output of the final combine is REPLICATED over the slice axis:

@@ -141,6 +141,41 @@ def main() -> int:
     print(json.dumps(row), flush=True)
     artifacts.record("tpu_check", row)
 
+    import numpy as np  # noqa: F811 - also imported in the try above
+
+    def make_rung(key_arr, pay_arr):
+        """Oracle-verified bitonic timing rung over the GIVEN arrays:
+        compile, verify keys AND payload pairing, then time.  One body
+        for the tile/fusion ladders and the rescue bisect so the
+        oracle/timing protocol cannot drift between them; error-isolated
+        per rung (a risky compile must not take down its ladder)."""
+        from locust_tpu.ops.pallas.sort import bitonic_sort as _bs
+
+        k_np = np.asarray(key_arr)
+        k_sorted = np.sort(k_np)
+
+        def bitonic_rung(label, **kw):
+            try:
+                f = jax.jit(functools.partial(_bs, interpret=False, **kw))
+                t0 = time.perf_counter()
+                sk, (sp,) = f(key_arr, (pay_arr,))
+                jax.block_until_ready(sk)
+                compile_s = time.perf_counter() - t0
+                sk_np, sp_np = np.asarray(sk), np.asarray(sp)
+                if not (
+                    np.array_equal(sk_np, k_sorted)
+                    and np.array_equal(k_np[sp_np], sk_np)
+                ):
+                    return {"error": "output failed oracle"}
+                ms = best_ms(lambda f=f: f(key_arr, (pay_arr,))[0])
+                print(f"[tpu_checks] bitonic {label}: {ms:.1f}ms",
+                      file=sys.stderr, flush=True)
+                return {"ms": round(ms, 3), "compile_s": round(compile_s, 1)}
+            except Exception as e:  # noqa: BLE001 - record the rung's loss
+                return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+        return bitonic_rung
+
     # 4. Bitonic tile sweep: where is the VMEM-residency/round-trip knee?
     # Only worth the compiles if check 3 compiled AND matched its oracle
     # (a wrong-output configuration must never seed the sweep's baseline).
@@ -152,36 +187,7 @@ def main() -> int:
     if "error" not in row and row.get("matches_oracle"):
         from locust_tpu.ops.pallas.sort import TILE_ROWS
 
-        key_np = np.asarray(key)
-        sorted_keys = np.sort(key_np)
-
-        def bitonic_rung(label, **kw):
-            """One oracle-verified timing of the bitonic kernel at a
-            non-default configuration: compile, verify keys AND payload
-            pairing against check 3's hoisted oracle arrays, then time.
-            Error-isolated per rung (a risky compile must not take down
-            the ladder); ONE body for both ladders so the oracle/timing
-            protocol cannot drift between them."""
-            try:
-                f = jax.jit(functools.partial(
-                    bitonic_sort, interpret=False, **kw
-                ))
-                t0 = time.perf_counter()
-                sk, (sp,) = f(key, (pay,))
-                jax.block_until_ready(sk)
-                compile_s = time.perf_counter() - t0
-                sk_np, sp_np = np.asarray(sk), np.asarray(sp)
-                if not (
-                    np.array_equal(sk_np, sorted_keys)
-                    and np.array_equal(key_np[sp_np], sk_np)
-                ):
-                    return {"error": "output failed oracle"}
-                ms = best_ms(lambda f=f: f(key, (pay,))[0])
-                print(f"[tpu_checks] bitonic {label}: {ms:.1f}ms",
-                      file=sys.stderr, flush=True)
-                return {"ms": round(ms, 3), "compile_s": round(compile_s, 1)}
-            except Exception as e:  # noqa: BLE001 - record the rung's loss
-                return {"error": f"{type(e).__name__}: {e}"[:300]}
+        bitonic_rung = make_rung(key, pay)
 
         # 4. Tile sweep: where is the VMEM-residency/round-trip knee?
         # The default tile reuses check 3's verified measurement — a
@@ -213,6 +219,35 @@ def main() -> int:
                 continue
             fused[str(mf)] = bitonic_rung(f"max_fused={mf}", max_fused=mf)
         row = {"check": "bitonic_fused_ab", "n": n, "fused": fused}
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
+    elif "key" in locals():
+        # Rescue bisect (VERDICT r4 next #3: "bisect kernel size until
+        # something compiles and commit whatever ms results"): the
+        # default configuration failed, so walk simpler schedules —
+        # tighter fusion caps first (fewer substages per Mosaic launch),
+        # then a 64x smaller array — until ANY rung yields a hardware
+        # millisecond.  Three rounds of zero kernel data is the failure
+        # mode this ladder exists to end; each rung is oracle-verified
+        # and error-isolated like the main ladders.
+        rescue = {}
+        rung_full = make_rung(key, pay)
+        for mf in (8, 2, 1):
+            rescue[f"n={n},max_fused={mf}"] = rung_full(
+                f"rescue max_fused={mf}", max_fused=mf
+            )
+            if "ms" in rescue[f"n={n},max_fused={mf}"]:
+                break
+        if not any("ms" in v for v in rescue.values()):
+            n_small = 1 << 16
+            rung_small = make_rung(key[:n_small], pay[:n_small])
+            for mf in (32, 1):
+                rescue[f"n={n_small},max_fused={mf}"] = rung_small(
+                    f"rescue n={n_small} max_fused={mf}", max_fused=mf
+                )
+                if "ms" in rescue[f"n={n_small},max_fused={mf}"]:
+                    break
+        row = {"check": "bitonic_rescue", "rungs": rescue}
         print(json.dumps(row), flush=True)
         artifacts.record("tpu_check", row)
     return 0
