@@ -140,7 +140,7 @@ class MapReduceEngine:
         tsize = cfg.resolved_table_size
         mode = cfg.sort_mode
 
-        from locust_tpu.ops.hash_table import reduce_into
+        from locust_tpu.ops.hash_table import fold_into
 
         def fold_block(acc: KVBatch, lines: jax.Array):
             """Map one block and merge its emits into the running table.
@@ -148,15 +148,14 @@ class MapReduceEngine:
             Sort modes: ONE sort of (table_size + emits_per_block) rows
             does both the block's shuffle-grouping and the cross-block
             merge.  Mode "hasht": the sort-free scatter fold with its
-            exactness ladder does the same in O(n)
-            (ops/hash_table.aggregate_exact).  Either way the running
-            distinct-key count is measured BEFORE the capacity slice so
-            a truncation in any fold is observable.
+            exactness ladder, rebuilt per fold (ops/hash_table.fold_into
+            — see there for why the incremental variant measured worse
+            and is not wired).  Either way the running distinct-key
+            count is measured BEFORE the capacity slice so a truncation
+            in any fold is observable.
             """
             kv, overflow = map_fn(lines, cfg)
-            merged, distinct = reduce_into(
-                KVBatch.concat(acc, kv), tsize, combine, mode
-            )
+            merged, distinct = fold_into(acc, kv, tsize, combine, mode)
             return merged, overflow, distinct
 
         def scan_blocks(blocks: jax.Array):

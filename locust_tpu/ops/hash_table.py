@@ -67,8 +67,29 @@ def hash_aggregate(
     out_size: int,
     combine: str = "sum",
     probes: int = DEFAULT_PROBES,
+    table: KVBatch | None = None,
 ) -> tuple[KVBatch, jax.Array, jax.Array]:
     """Aggregate ``batch`` into an ``out_size``-slot table without sorting.
+
+    With ``table`` (a KVBatch of capacity ``out_size`` produced by a
+    previous hasht fold), aggregation is INCREMENTAL: prior keys keep
+    their slots and batch rows combine into them, so a fold's scatter
+    traffic scales with the BLOCK, not table+block — the concat +
+    full-table re-aggregation the sort modes pay per fold disappears.
+    Slot stability across folds follows from the probe invariant: a key
+    resolved at round r found every earlier slot of its sequence
+    occupied, and slots never empty out, so later rows of that key walk
+    the same sequence to the same slot.
+
+    EXCEPTION — keys that entered the table via the exactness ladder's
+    residual/full branches sit at slots OFF their probe sequence; later
+    batch rows of such a key cannot match there and may claim a second
+    slot (or re-residual).  That SPLITS the key's total across rows —
+    still exact, because every consumer merges duplicate key rows with
+    the combine op (``finalize_host_pairs``; the ladder's own ``full``
+    branch and the sort-mode merges consolidate them too) — but the
+    ``used``/distinct count then OVERCOUNTS, so capacity truncation
+    stays conservative (may flag early, never silently drops).
 
     Returns ``(table, used_count, unresolved_mask)``:
 
@@ -97,8 +118,33 @@ def hash_aggregate(
     # empty-slot sentinel; leave such rows to the exact fallback.
     unresolved = valid & (lanes[:, 0] != 0)
 
-    stored_lanes = jnp.zeros((T + 1, n_lanes), jnp.uint32)  # row T = dump
-    acc = jnp.full((T + 1,), _COMBINE_INIT[combine], jnp.int32)
+    if table is None:
+        stored_lanes = jnp.zeros((T + 1, n_lanes), jnp.uint32)  # T = dump
+        acc = jnp.full((T + 1,), _COMBINE_INIT[combine], jnp.int32)
+    else:
+        if table.size != T:
+            raise ValueError(
+                f"incremental table capacity {table.size} != out_size {T}"
+            )
+        # Existing slots keep their keys/values; EMPTY slots must hold
+        # the combine identity (table.values stores 0 there), and a
+        # stored key in an invalid slot must not block claims — masked
+        # to the empty sentinel pattern.
+        stored_lanes = jnp.concatenate(
+            [
+                jnp.where(table.valid[:, None], table.key_lanes, 0),
+                jnp.zeros((1, n_lanes), jnp.uint32),
+            ]
+        )
+        acc = jnp.concatenate(
+            [
+                jnp.where(
+                    table.valid, table.values,
+                    jnp.int32(_COMBINE_INIT[combine]),
+                ),
+                jnp.full((1,), _COMBINE_INIT[combine], jnp.int32),
+            ]
+        )
     # A slot counts as used only once some row has FULL-KEY-matched it.
     # Written-but-never-matched slots are possible in exactly one case:
     # two distinct keys collide on the 31-bit folded hash, both win the
@@ -107,8 +153,14 @@ def hash_aggregate(
     # stored bytes then match neither writer.  Without this flag such a
     # slot would surface as a phantom output row holding the combine
     # init; with it, the slot is excluded and both writers resolve via
-    # later probes or the exact fallback ladder.
-    matched_slot = jnp.zeros((T + 1,), bool)
+    # later probes or the exact fallback ladder.  Slots carried in from
+    # a previous incremental fold were matched when first inserted.
+    if table is None:
+        matched_slot = jnp.zeros((T + 1,), bool)
+    else:
+        matched_slot = jnp.concatenate(
+            [table.valid, jnp.zeros((1,), bool)]
+        )
 
     for p in range(probes):
         slot = ((h1 + jnp.uint32(p) * step) % jnp.uint32(T)).astype(jnp.int32)
@@ -182,9 +234,13 @@ def place_residual(
     Caller guarantees ``sum(unresolved) <= RESIDUAL_CAP``.  Steps:
 
       1. cumsum-compact the unresolved rows into a RESIDUAL_CAP buffer;
-      2. group+total the buffer with the stock sort + segment reduce
-         (residual keys are NEVER already in the table — they failed the
-         full-lane match at every probe — so totals are disjoint);
+      2. group+total the buffer with the stock sort + segment reduce.
+         A residual key failed the full-lane match at every PROBE slot,
+         so its total is disjoint from any probe-resolved slot; with
+         incremental folds it may still duplicate a row placed off its
+         probe sequence by an EARLIER ladder descent — exact regardless,
+         because all consumers merge duplicate key rows (see
+         hash_aggregate's incremental exception note);
       3. place the k-th residual key into the k-th empty slot (rank maps
          built with one cumsum each).  Keys beyond the empty-slot count
          are dropped but still counted in the returned distinct total,
@@ -327,13 +383,61 @@ def reduce_into(
     )
 
 
+def fold_into(
+    acc: KVBatch,
+    batch: KVBatch,
+    out_size: int,
+    combine: str,
+    sort_mode: str,
+) -> tuple[KVBatch, jax.Array]:
+    """Fold a batch of NEW rows into an existing bounded table.
+
+    The accumulator-merge counterpart of :func:`reduce_into` — call
+    this when ``acc`` is itself the output of a previous fold at the
+    same ``(out_size, combine, sort_mode)``:
+
+    * sort modes: ``concat(acc, batch)`` then one sort + segment reduce
+      — the table IS sorted back in with the emits (one fused sort does
+      grouping and merge);
+    * "hasht": ``aggregate_exact`` over the same concat — a per-fold
+      REBUILD, deliberately NOT the incremental
+      ``hash_aggregate(table=acc)`` mode.  Measured round 5 (CPU bench,
+      hamlet-repeated 8MB): incremental wiring LOST — 8.1 -> 6.5 MB/s
+      and distinct drifted 5608 -> 5631, because a key the probe rounds
+      strand (all its slots taken; ~2 keys on hamlet) is placed OFF its
+      probe sequence by the residual branch and then accumulates one
+      duplicate row EVERY subsequent fold (linear growth; rebuild keeps
+      exactly one row).  The distinct drift would additionally poison
+      bench's lossless-side A/B guard (max-distinct anchor).  Wiring
+      incremental for real needs a slot-stable STASH side-table for
+      stranded keys — future work; the capability + its exactness
+      contract stay tested at the hash_aggregate level.
+    """
+    if sort_mode == "hasht":
+        return aggregate_exact(KVBatch.concat(acc, batch), out_size, combine)
+    from locust_tpu.ops.process_stage import sort_and_compact
+    from locust_tpu.ops.reduce_stage import segment_reduce_into
+
+    return segment_reduce_into(
+        sort_and_compact(KVBatch.concat(acc, batch), sort_mode),
+        out_size,
+        combine,
+    )
+
+
 def aggregate_exact(
     batch: KVBatch,
     out_size: int,
     combine: str = "sum",
     probes: int | None = None,
+    into: KVBatch | None = None,
 ) -> tuple[KVBatch, jax.Array]:
     """The full sort-free fold with its exactness ladder, as one call.
+
+    ``into`` (a table from a previous hasht fold at the same shape)
+    switches :func:`hash_aggregate` to its incremental mode; the ladder
+    below is unchanged — its ``small``/``full`` branches already merge
+    residual rows into an arbitrary existing table.
 
     ``hash_aggregate`` + the three-way unresolved-row ladder the engine's
     "hasht" fold documents (engine.fold_block_hasht): 0 unresolved → the
@@ -366,6 +470,7 @@ def aggregate_exact(
     table, used, unresolved = hash_aggregate(
         batch, out_size, combine,
         probes=DEFAULT_PROBES if probes is None else probes,
+        table=into,
     )
     n_unres = jnp.sum(unresolved.astype(jnp.int32))
 

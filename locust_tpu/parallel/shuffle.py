@@ -38,7 +38,7 @@ from locust_tpu.config import EngineConfig
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
-from locust_tpu.ops.hash_table import reduce_into
+from locust_tpu.ops.hash_table import fold_into, reduce_into
 from locust_tpu.parallel.mesh import DATA_AXIS
 
 logger = logging.getLogger("locust_tpu")
@@ -416,12 +416,11 @@ def build_shuffle_step(
             valid=recv_valid.reshape(-1),
         )
         # Merge what we received with our carried shard, re-reduce.
-        # reduce_into dispatches sort vs the "hasht" sort-free fold (no
-        # collectives inside, so each shard branches its exactness ladder
-        # independently under shard_map).
-        both = KVBatch.concat(acc, received)
-        new_acc, distinct = reduce_into(
-            both, shard_capacity, combine, cfg.sort_mode
+        # fold_into dispatches sort vs the "hasht" sort-free fold (no
+        # collectives inside, so each shard branches its exactness
+        # ladder independently under shard_map).
+        new_acc, distinct = fold_into(
+            acc, received, shard_capacity, combine, cfg.sort_mode
         )
         # The backlog rides psum over stat_axes so every device in the
         # shuffle group sees the same value — which is what lets the drain

@@ -225,6 +225,103 @@ def test_degenerate_hash_exact_and_no_phantom_slots(monkeypatch):
     assert sum(got.values()) + int(np.asarray(unresolved).sum()) == len(words)
 
 
+def test_incremental_aggregate_matches_oracle_across_blocks():
+    """aggregate_exact(into=...) — the INCREMENTAL capability (not wired
+    into the engines; see ops/hash_table.fold_into for the measured
+    reason): folding three overlapping batches one after another must
+    equal one aggregation of everything, prior keys combining into
+    their existing slots."""
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.ops.hash_table import aggregate_exact
+
+    rng = np.random.default_rng(5)
+    vocab = [f"w{i}".encode() for i in range(120)]
+    batches = [
+        [vocab[i] for i in rng.integers(0, len(vocab), 700)]
+        for _ in range(3)
+    ]
+    acc = KVBatch.empty(1024, 8)
+    for words in batches:
+        acc, _ = aggregate_exact(_batch(words), 1024, "sum", into=acc)
+    oracle = collections.Counter(b for ws in batches for b in ws)
+    # finalize-equivalent merge (duplicate rows combine):
+    merged: dict[bytes, int] = {}
+    for k, v in _table_dict(acc).items():
+        merged[k] = merged.get(k, 0) + v
+    assert merged == dict(oracle)
+
+
+@pytest.mark.parametrize("combine", ["min", "max"])
+def test_incremental_fold_min_max_empty_slot_init(combine):
+    """Carried empty slots must re-initialize to the combine identity
+    (stored 0 would corrupt a later min over positive values)."""
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.ops.hash_table import aggregate_exact
+
+    acc = KVBatch.empty(64, 8)
+    acc, _ = aggregate_exact(
+        _batch([b"a", b"b"], values=[5, -7]), 64, combine, into=acc
+    )
+    acc, _ = aggregate_exact(
+        _batch([b"a", b"c"], values=[9, 3]), 64, combine, into=acc
+    )
+    op = min if combine == "min" else max
+    assert _table_dict(acc) == {b"a": op(5, 9), b"b": -7, b"c": 3}
+
+
+def test_incremental_fold_under_capacity_pressure_is_loud_never_over():
+    """Keys placed by the residual/full branches sit off their probe
+    sequence; later incremental folds may split their totals across
+    rows.  Under CAPACITY pressure the bounded table can then drop a
+    key's residual placement — best-effort totals, same as the rebuild
+    design's head-slice truncation — but the contract is (a) the
+    distinct signal must exceed capacity (so the engine flags
+    ``truncated``), and (b) no kept key may ever OVERCOUNT."""
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.engine import finalize_host_pairs
+    from locust_tpu.ops.hash_table import aggregate_exact
+
+    rng = np.random.default_rng(9)
+    vocab = [f"key{i}".encode() for i in range(60)]  # ~load factor 0.9
+    acc = KVBatch.empty(64, 8)
+    all_words = []
+    max_distinct = 0
+    for _ in range(4):
+        words = [vocab[i] for i in rng.integers(0, len(vocab), 400)]
+        all_words += words
+        acc, distinct = aggregate_exact(_batch(words), 64, "sum", into=acc)
+        max_distinct = max(max_distinct, int(distinct))
+    got = dict(finalize_host_pairs(acc, "sum"))
+    oracle = collections.Counter(all_words)
+    wrong = {k: (v, oracle[k]) for k, v in got.items() if v != oracle[k]}
+    if wrong:
+        # Partial totals are only permitted when the loud truncation
+        # signal fired (distinct count past capacity).
+        assert max_distinct > 64, (max_distinct, wrong)
+    for k, v in got.items():
+        assert v <= oracle[k], f"{k!r} overcounted: {v} > {oracle[k]}"
+
+
+def test_incremental_fold_exact_when_within_capacity():
+    """Same shape of test WITHOUT capacity pressure: repeated incremental
+    folds (including probe-failure residual descents at a high-ish load
+    factor) must be byte-exact under the finalize merge."""
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.engine import finalize_host_pairs
+    from locust_tpu.ops.hash_table import aggregate_exact
+
+    rng = np.random.default_rng(11)
+    vocab = [f"key{i}".encode() for i in range(60)]
+    acc = KVBatch.empty(256, 8)
+    all_words = []
+    for _ in range(4):
+        words = [vocab[i] for i in rng.integers(0, len(vocab), 400)]
+        all_words += words
+        acc, _ = aggregate_exact(_batch(words), 256, "sum", into=acc)
+    got = dict(finalize_host_pairs(acc, "sum"))
+    assert got == dict(collections.Counter(all_words))
+
+
 def test_debug_checks_accept_hasht_tables(monkeypatch):
     """LOCUST_DEBUG_CHECKS must not reject hasht's slot-ordered (non
     prefix-compact) tables — reproduces the round-4 review finding."""
