@@ -91,6 +91,28 @@ def size_caps(
     return kw, epl
 
 
+def count_distinct_tokens(lines) -> int:
+    """Exact distinct-token count under the ENGINE's tokenization
+    (FULL_DELIMITERS split, empties dropped), deduplicating lines first
+    so replicated corpora count each unique line once.
+
+    Upper-bounds the engine's distinct-key count: per-line emit
+    overflow can only DROP tokens, and key-width truncation never
+    applies when paired with ``auto_caps`` (key_width >= max token).  A
+    table sized >= this count therefore cannot truncate — the guarantee
+    bench.py's distinct-aware table sizing rests on.
+    """
+    import re
+
+    from locust_tpu.config import FULL_DELIMITERS
+
+    pat = re.compile(b"[" + re.escape(FULL_DELIMITERS) + b"]+")
+    toks: set[bytes] = set()
+    for ln in set(lines):
+        toks.update(t for t in pat.split(ln) if t)
+    return len(toks)
+
+
 def auto_caps(lines, key_cap: int, emits_cap: int) -> tuple[int, int, int, int]:
     """Lossless capacity sizing: the single policy behind bench.py and
     ``--auto-caps`` (cli.py).

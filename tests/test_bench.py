@@ -465,3 +465,25 @@ def test_best_tpu_ab_row_picks_max_and_labels(tmp_path, monkeypatch):
 def test_best_tpu_ab_row_empty_ledger(tmp_path, monkeypatch):
     monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
     assert bench._best_tpu_ab_row() is None
+
+
+def test_auto_table_size_rule():
+    """Distinct-aware table sizing: power of two >= 2x distinct, floor
+    4096, ceiling the default resolution."""
+    assert bench._auto_table_size(100, 65536) == 4096
+    assert bench._auto_table_size(2048, 65536) == 4096
+    assert bench._auto_table_size(2049, 65536) == 8192
+    assert bench._auto_table_size(5608, 65536) == 16384
+    assert bench._auto_table_size(60000, 65536) == 65536   # ceiling
+    assert bench._auto_table_size(500000, 65536) == 65536  # never above
+
+
+def test_count_distinct_tokens_engine_semantics():
+    from locust_tpu.io.loader import count_distinct_tokens
+
+    lines = [b"to be, or not to-be", b"to be, or not to-be", b"that\tis"]
+    # strtok semantics: ',' '-' '\t' split; duplicates (incl. whole
+    # duplicate lines) count once: to, be, or, not, that, is
+    assert count_distinct_tokens(lines) == 6
+    assert count_distinct_tokens([]) == 0
+    assert count_distinct_tokens([b"", b"  , "]) == 0
