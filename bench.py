@@ -332,16 +332,52 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
                 f"[bench] block-lines evidence skipped ({type(e).__name__}: {e})",
                 file=sys.stderr,
             )
+        # table_size: adopt only a size measured AT the adopted
+        # (sort_mode, block_lines) — the distinct-aware accumulator
+        # sizing (engine_table_ab rows; the fold re-aggregates every
+        # table row per block, so right-sizing to the vocabulary wins
+        # when the default is mostly padding).  Truncated sides record
+        # truncated=True and are additionally dropped by lossless_sides'
+        # distinct anchor.
+        try:
+            row = newest_matching(
+                _tpu_rows("engine_table_ab"),
+                extra=lambda r: (
+                    r.get("sort_mode", "hash") == out["sort_mode"]
+                    and int(r.get("block_lines", 32768)) == out["block_lines"]
+                ),
+            )
+            if row is not None:
+                tables = lossless_sides(row.get("tables") or {})
+                tables = {
+                    k: v for k, v in tables.items() if not v.get("truncated")
+                }
+                best = max(
+                    tables, key=lambda t: side_mb(tables.get(t)), default=None
+                )
+                if best is not None and side_mb(tables.get(best)) > 0.0:
+                    out["table_size"] = int(best)
+                    print(
+                        f"[bench] evidence-tuned table_size={best} "
+                        f"({tables[best].get('mb_s')} MB/s in the last TPU A/B)",
+                        file=sys.stderr,
+                    )
+        except Exception as e:  # noqa: BLE001 - skip this kind only
+            print(
+                f"[bench] table-size evidence skipped ({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
         # use_pallas: adopt only a measured engine-level win, and only if
-        # the row was swept AT the adopted (sort_mode, block_lines) —
-        # same joint-measurement rule as above.  A side that errored has
-        # no "mb_s" key and loses.
+        # the row was swept AT the adopted (sort_mode, block_lines,
+        # table_size) — same joint-measurement rule as above.  A side
+        # that errored has no "mb_s" key and loses.
         try:
             row = newest_matching(
                 _tpu_rows("engine_pallas_ab"),
                 extra=lambda r: (
                     r.get("sort_mode", "hash") == out["sort_mode"]
                     and int(r.get("block_lines", 32768)) == out["block_lines"]
+                    and r.get("table_size") == out.get("table_size")
                 ),
             )
             if row is not None:
@@ -510,6 +546,10 @@ def run_bench(backend: str) -> dict:
     table_size = None
     if _TABLE_ENV:
         table_size = int(_TABLE_ENV)
+    elif backend == "tpu":
+        # Evidence-tuned only (engine_table_ab rows measured at the
+        # adopted mode+block): the TPU config must stay jointly measured.
+        table_size = defaults.get("table_size")
     elif backend == "cpu" and not (_EMITS_ENV and _KEY_WIDTH_ENV):
         from locust_tpu.io.loader import count_distinct_tokens
 

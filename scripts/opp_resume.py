@@ -74,7 +74,7 @@ def tunnel_gate() -> bool:
 
 
 def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
-                  block_lines: int, caps=None) -> None:
+                  block_lines: int, caps=None, table_size=None) -> None:
     """jax.profiler device capture at the winning headline configuration
     (VERDICT r4 next #4): utilization computed from MEASURED device time
     instead of the analytic traffic model timing itself against
@@ -92,11 +92,12 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
     from locust_tpu.utils import artifacts, profiling, roofline
 
     row = {"sort_mode": sort_mode, "block_lines": block_lines, "caps": caps,
+           "table_size": table_size,
            "corpus_mb": round(corpus_bytes / 1e6, 1)}
     try:
         eng = get_engine(
-            bench.bench_engine_config(block_lines, sort_mode=sort_mode,
-                                      **(caps or {}))
+            bench.bench_engine_config(block_lines, table_size=table_size,
+                                      sort_mode=sort_mode, **(caps or {}))
         )
         blocks = eng.prepare_blocks(rows_ab)
         blocks.block_until_ready()
@@ -513,9 +514,90 @@ def phase_block_lines(rows_ab, corpus_bytes, sort_mode: str = "hash",
     return int(best_key), best_blocks
 
 
+def phase_table_ab(rows_ab, corpus_bytes, sort_mode: str,
+                   block_lines: int, caps=None, blocks=None):
+    """Accumulator-size A/B at the winning (sort_mode, block_lines)
+    (round-5 CPU finding transferred to TPU the evidence-tuned way):
+    the fold re-aggregates every table row per block, and the default
+    min(65536, emits_per_block) table is mostly padding at real
+    vocabularies.  Sizes: the default, and the distinct-aware rule's
+    choice (bench._auto_table_size) with one step below it.  The row
+    records the measured distinct-token count; bench adopts only a
+    jointly-measured (mode, block, table) chain, and only lossless
+    sides (distinct/overflow recorded per side).
+
+    Returns the winning table size (None = default, so downstream
+    phases and tuning treat legacy behavior uniformly).
+    """
+    import bench
+
+    from locust_tpu.io.loader import count_distinct_tokens
+    from locust_tpu.utils import artifacts
+
+    try:
+        from locust_tpu.config import EngineConfig
+
+        d = EngineConfig(block_lines=block_lines)
+        # rows_ab are padded device rows; count on the host lines the
+        # corpus loader produced (cheap: dedup first).
+        lines = bench.load_corpus(
+            int(os.environ.get("LOCUST_OPP_AB_BYTES", 32 << 20))
+        )
+        distinct = count_distinct_tokens([ln[: d.line_width] for ln in lines])
+        auto = bench._auto_table_size(distinct, d.resolved_table_size)
+        sizes = [d.resolved_table_size]
+        if auto < d.resolved_table_size:
+            sizes.append(auto)
+            if auto // 2 >= max(4096, distinct):
+                sizes.append(auto // 2)
+    except Exception as e:  # noqa: BLE001 - phase must not kill the sweep
+        artifacts.record("engine_table_ab",
+                         {"error": f"{type(e).__name__}: {e}"[:300]})
+        return None
+    results = {}
+    best_size, best_mb = None, -1.0
+    for ts in sizes:
+        try:
+            eng = get_engine(
+                bench.bench_engine_config(block_lines, table_size=ts,
+                                          sort_mode=sort_mode,
+                                          **(caps or {}))
+            )
+            if blocks is None:
+                blocks = eng.prepare_blocks(rows_ab)
+                blocks.block_until_ready()
+            eng.run_blocks(blocks)  # compile + warm
+            best, res = float("inf"), None
+            for _ in range(3):
+                res = eng.run_blocks(blocks)
+                best = min(best, res.times.total_ms / 1e3)
+            results[str(ts)] = {
+                "mb_s": round(corpus_bytes / 1e6 / best, 2),
+                "best_s": round(best, 4),
+                "distinct": res.num_segments,
+                "overflow_tokens": res.overflow_tokens,
+                "truncated": res.truncated,
+            }
+            if not res.truncated and results[str(ts)]["mb_s"] > best_mb:
+                best_mb, best_size = results[str(ts)]["mb_s"], ts
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            results[str(ts)] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"[opp] table_size={ts}: {results[str(ts)]}", file=sys.stderr)
+        artifacts.record(
+            "engine_table_ab",
+            {"corpus_mb": round(corpus_bytes / 1e6, 1), "caps": caps,
+             "sort_mode": sort_mode, "block_lines": block_lines,
+             "measured_distinct": distinct, "tables": dict(results),
+             "partial": ts != sizes[-1]},
+        )
+    if best_size == sizes[0]:
+        return None  # default won; no override to carry forward
+    return best_size
+
+
 def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
                     block_lines: int = 32768, caps=None,
-                    blocks=None) -> None:
+                    blocks=None, table_size=None) -> None:
     """Engine end-to-end with the Pallas vs jnp Map tokenizer at the
     winning (sort_mode, block_lines) configuration — the joint
     measurement that can justify flipping the use_pallas default
@@ -534,7 +616,8 @@ def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
     for flag in (False, True):
         try:
             eng = get_engine(
-                bench.bench_engine_config(block_lines, sort_mode=sort_mode,
+                bench.bench_engine_config(block_lines, table_size=table_size,
+                                          sort_mode=sort_mode,
                                           use_pallas=flag, **(caps or {}))
             )
             if blocks is None:
@@ -558,12 +641,14 @@ def phase_pallas_ab(rows_ab, corpus_bytes, sort_mode: str = "hash",
     artifacts.record(
         "engine_pallas_ab",
         {"corpus_mb": round(corpus_bytes / 1e6, 1), "sort_mode": sort_mode,
-         "block_lines": block_lines, "caps": caps, "pallas": results},
+         "block_lines": block_lines, "table_size": table_size,
+         "caps": caps, "pallas": results},
     )
 
 
 def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
-                          block_lines: int, caps=None) -> None:
+                          block_lines: int, caps=None,
+                          table_size=None) -> None:
     """Per-stage timing at the WINNING headline configuration.
 
     stage_parity (below) reports the reference's own shapes (700/4463
@@ -583,8 +668,8 @@ def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
 
     try:
         eng = get_engine(
-            bench.bench_engine_config(block_lines, sort_mode=sort_mode,
-                                      **(caps or {}))
+            bench.bench_engine_config(block_lines, table_size=table_size,
+                                      sort_mode=sort_mode, **(caps or {}))
         )
         eng.timed_run(rows_ab)  # compile + warm
         best = None
@@ -596,6 +681,7 @@ def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
             "corpus_mb": round(corpus_bytes / 1e6, 1),
             "sort_mode": sort_mode,
             "block_lines": block_lines,
+            "table_size": table_size,
             "caps": caps,
             "map_ms": round(best.times.map_ms, 1),
             "process_ms": round(best.times.process_ms, 1),
@@ -787,15 +873,20 @@ def run_phases() -> None:
     best_bl, best_blocks = phase_block_lines(
         rows_ab, corpus_bytes, sort_mode=winner, caps=caps
     )
+    best_ts = phase_table_ab(rows_ab, corpus_bytes, sort_mode=winner,
+                             block_lines=best_bl, caps=caps,
+                             blocks=best_blocks)
     phase_pallas_ab(rows_ab, corpus_bytes, sort_mode=winner,
-                    block_lines=best_bl, caps=caps, blocks=best_blocks)
+                    block_lines=best_bl, caps=caps, blocks=best_blocks,
+                    table_size=best_ts)
     # VERDICT r4 order: measured utilization (#4) and the device-vs-
     # tunnel decomposition (#5) before the informational tables.
     phase_profile(rows_ab, corpus_bytes, sort_mode=winner,
-                  block_lines=best_bl, caps=caps)
+                  block_lines=best_bl, caps=caps, table_size=best_ts)
     phase_stage_device_time()
     phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode=winner,
-                          block_lines=best_bl, caps=caps)
+                          block_lines=best_bl, caps=caps,
+                          table_size=best_ts)
     phase_stage_parity()
     phase_emits_ab(rows_ab, corpus_bytes, key_width=kw)
     phase_key_width_ab(rows_ab, corpus_bytes)

@@ -487,3 +487,67 @@ def test_count_distinct_tokens_engine_semantics():
     assert count_distinct_tokens(lines) == 6
     assert count_distinct_tokens([]) == 0
     assert count_distinct_tokens([b"", b"  , "]) == 0
+
+
+def test_evidence_tuning_adopts_table_size_jointly(tmp_path, monkeypatch, capsys):
+    """engine_table_ab adoption: only at the adopted (mode, block) pair,
+    truncated sides never win, and the pallas joint rule now includes
+    the adopted table."""
+    static = {"block_lines": 32768, "sort_mode": "hash", "use_pallas": False}
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(tmp_path))
+    with open(tmp_path / "tpu_runs.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"kind": "engine_sort_mode_ab", "backend": "tpu",
+             "modes": {"hasht": {"mb_s": 70.0, "distinct": 5608}}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "block_lines_ab", "backend": "tpu",
+             "sort_mode": "hasht",
+             "blocks": {"65536": {"mb_s": 72.0, "distinct": 5608}}}
+        ) + "\n")
+        f.write(json.dumps(
+            {"kind": "engine_table_ab", "backend": "tpu",
+             "sort_mode": "hasht", "block_lines": 65536,
+             "measured_distinct": 5608,
+             "tables": {
+                 "65536": {"mb_s": 72.0, "distinct": 5608,
+                           "truncated": False},
+                 "16384": {"mb_s": 80.0, "distinct": 5608,
+                           "truncated": False},
+                 "4096": {"mb_s": 95.0, "distinct": 4096,
+                          "truncated": True},
+             }}
+        ) + "\n")
+        # Pallas row measured WITHOUT the adopted table -> joint fails.
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hasht", "block_lines": 65536,
+             "pallas": {"True": {"mb_s": 99.0}, "False": {"mb_s": 70.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned["sort_mode"] == "hasht"
+    assert tuned["block_lines"] == 65536
+    assert tuned["table_size"] == 16384  # fastest LOSSLESS side
+    assert tuned["use_pallas"] is False  # table mismatch blocks the flip
+
+    # A pallas row AT the adopted table flips it.
+    with open(tmp_path / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"kind": "engine_pallas_ab", "backend": "tpu",
+             "sort_mode": "hasht", "block_lines": 65536,
+             "table_size": 16384,
+             "pallas": {"True": {"mb_s": 99.0}, "False": {"mb_s": 70.0}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned["use_pallas"] is True
+
+    # A table row at a DIFFERENT mode/block pair is never adopted.
+    with open(tmp_path / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"kind": "engine_table_ab", "backend": "tpu",
+             "sort_mode": "hashp2", "block_lines": 32768,
+             "tables": {"8192": {"mb_s": 120.0, "distinct": 5608,
+                                 "truncated": False}}}
+        ) + "\n")
+    tuned = bench._evidence_tuned_tpu_defaults(static)
+    assert tuned["table_size"] == 16384
