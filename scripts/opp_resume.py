@@ -880,10 +880,14 @@ def run_phases() -> None:
                     block_lines=best_bl, caps=caps, blocks=best_blocks,
                     table_size=best_ts)
     # VERDICT r4 order: measured utilization (#4) and the device-vs-
-    # tunnel decomposition (#5) before the informational tables.
+    # tunnel decomposition (#5) before the informational tables.  The
+    # decomposition runs FIRST: jax.profiler has never run against the
+    # axon remote plugin, and an in-C hang there (unkillable in-process)
+    # would otherwise cost the window every later phase — ordinary
+    # compiles are the known-safe risk.
+    phase_stage_device_time()
     phase_profile(rows_ab, corpus_bytes, sort_mode=winner,
                   block_lines=best_bl, caps=caps, table_size=best_ts)
-    phase_stage_device_time()
     phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode=winner,
                           block_lines=best_bl, caps=caps,
                           table_size=best_ts)
