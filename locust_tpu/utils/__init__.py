@@ -1,3 +1,30 @@
-from locust_tpu.utils.artifacts import on_tpu, record  # noqa: F401
-from locust_tpu.utils.checks import checkify_pipeline, validate_batch  # noqa: F401
-from locust_tpu.utils.profiling import SpanTimer, device_trace  # noqa: F401
+"""Aux utilities: evidence ledger, invariant checks, tracing/profiling.
+
+Lazy re-exports (PEP 562): ``checks``/``profiling`` import jax at module
+top, but jax-free supervisors (scripts/farm_loop.py) need
+``utils.artifacts``'s ledger readers without pulling jax into a
+long-lived process under the axon sitecustomize — an eager package
+__init__ would do exactly that transitively.
+"""
+
+_EXPORTS = {
+    "on_tpu": "locust_tpu.utils.artifacts",
+    "record": "locust_tpu.utils.artifacts",
+    "ledger_rows": "locust_tpu.utils.artifacts",
+    "latest_row_ts": "locust_tpu.utils.artifacts",
+    "checkify_pipeline": "locust_tpu.utils.checks",
+    "validate_batch": "locust_tpu.utils.checks",
+    "SpanTimer": "locust_tpu.utils.profiling",
+    "device_trace": "locust_tpu.utils.profiling",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod_name = _EXPORTS.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), name)

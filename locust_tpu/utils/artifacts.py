@@ -40,6 +40,62 @@ def artifacts_dir() -> str:
     return os.environ.get("LOCUST_ARTIFACTS_DIR", _DEFAULT_DIR)
 
 
+def ledger_rows(path: str | None = None) -> list[dict]:
+    """Parsed rows of the evidence ledger (malformed lines skipped).
+
+    The single ledger reader: the farm loop's harvest schedule, the
+    sweep's phase skips, and bench's evidence tuning all decide off this
+    file, and it is appended by concurrent processes and merged across
+    machines via git — every consumer must treat it as untrusted,
+    per-line.  One shared copy so a hardening fix can't miss a caller.
+
+    ``path`` pins an explicit ledger file; default is the live
+    ``artifacts_dir()`` ledger.  Callers whose WRITES are pinned (the
+    farm loop git-commits the repo ledger) must pin their reads to the
+    same file or the two silently diverge under $LOCUST_ARTIFACTS_DIR.
+    """
+    rows: list[dict] = []
+    try:
+        with open(
+            path or os.path.join(artifacts_dir(), "tpu_runs.jsonl"),
+            encoding="utf-8",
+        ) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(r, dict):
+                    rows.append(r)
+    except OSError:
+        pass
+    return rows
+
+
+def latest_row_ts(
+    kind: str, backend: str = "tpu", where=None, path: str | None = None
+) -> float:
+    """Newest ``ts`` among ledger rows of ``kind``/``backend`` that also
+    satisfy the optional ``where`` predicate.  Rows with missing or
+    malformed ``ts`` (ledger is multi-writer, git-merged) are skipped,
+    never raised on — one bad line must not cost a tunnel window."""
+    ts = 0.0
+    for r in ledger_rows(path):
+        if r.get("kind") != kind or r.get("backend") != backend:
+            continue
+        if where is not None:
+            try:
+                if not where(r):
+                    continue
+            except Exception:
+                continue
+        try:
+            ts = max(ts, float(r.get("ts") or 0))
+        except (TypeError, ValueError):
+            continue
+    return ts
+
+
 def on_tpu() -> bool:
     """True iff jax is initialized on a non-CPU backend.
 

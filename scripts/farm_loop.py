@@ -22,7 +22,6 @@ Run detached:  nohup python scripts/farm_loop.py --hours 10 \
 """
 
 import argparse
-import json
 import os
 import subprocess
 import sys
@@ -31,6 +30,30 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LEDGER = os.path.join(REPO, "artifacts", "tpu_runs.jsonl")
 PROFILES = os.path.join(REPO, "artifacts", "profiles")
+SESSION_TS = time.time()  # farm start: floor for the sweep's phase skips
+
+sys.path.insert(0, REPO)
+# The one hardened ledger reader.  This import chain is jax-free
+# (locust_tpu/__init__ and utils/__init__ are both lazy; artifacts.py
+# imports no jax at module top) — this supervisor must STAY jax-free for
+# its whole life, because a wedged axon tunnel hangs any process that
+# touches a jax backend; probes/jobs run in killable subprocesses
+# instead.  test_farm_loop_import_is_jax_free pins the invariant.
+from locust_tpu.utils.artifacts import (  # noqa: E402
+    latest_row_ts as _latest_row_ts,
+    ledger_rows as _ledger_rows,
+)
+
+
+def ledger_rows() -> list[dict]:
+    # Reads pinned to LEDGER — the same file commit_ledger() git-commits
+    # — so a $LOCUST_ARTIFACTS_DIR override can't make the harvest
+    # schedule and the committed evidence diverge.
+    return _ledger_rows(LEDGER)
+
+
+def latest_ts(kind: str, backend: str = "tpu") -> float:
+    return _latest_row_ts(kind, backend, path=LEDGER)
 
 
 def log(msg: str) -> None:
@@ -87,28 +110,6 @@ def probe() -> bool:
         return r.returncode == 0
     except Exception:
         return False
-
-
-def ledger_rows() -> list[dict]:
-    rows = []
-    try:
-        with open(LEDGER) as f:
-            for line in f:
-                try:
-                    rows.append(json.loads(line))
-                except ValueError:
-                    pass
-    except OSError:
-        pass
-    return rows
-
-
-def latest_ts(kind: str, backend: str = "tpu") -> float:
-    ts = 0.0
-    for r in ledger_rows():
-        if r.get("kind") == kind and r.get("backend") == backend:
-            ts = max(ts, float(r.get("ts", 0)))
-    return ts
 
 
 def run(cmd: list[str], timeout: float, env: dict | None = None) -> int:
@@ -186,7 +187,10 @@ def next_ab_bytes() -> int:
             and isinstance(r["modes"].get("hasht"), dict)
             and "mb_s" in r["modes"]["hasht"]
         ):
-            done_mb.add(round(float(r.get("corpus_mb") or 0)))
+            try:
+                done_mb.add(round(float(r.get("corpus_mb") or 0)))
+            except (TypeError, ValueError):
+                continue  # multi-writer ledger: never crash the loop
     for mb, nbytes in ((34, 32 << 20), (8, 8 << 20), (67, 64 << 20)):
         if mb not in done_mb:
             return nbytes
@@ -211,6 +215,9 @@ def harvest_window() -> None:
             "LOCUST_FARM_STREAM_MB", "512")
     env["LOCUST_OPP_AB_BYTES"] = os.environ.get(
         "LOCUST_OPP_AB_BYTES", str(next_ab_bytes()))
+    # Session scope for the sweep's "already answered" skips: only rows
+    # produced after THIS farm loop started retire its phases 1-2.
+    env["LOCUST_SESSION_TS"] = str(SESSION_TS)
     run([sys.executable, os.path.join("scripts", "tpu_opportunistic.py")],
         timeout=2400, env=env)
     commit_ledger()

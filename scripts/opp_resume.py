@@ -22,7 +22,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
+from locust_tpu.config import (  # noqa: E402 - jax-free
+    default_sort_mode,
+    machine_cache_dir,
+)
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
@@ -860,41 +863,107 @@ def phase_stream() -> None:
     print(f"[opp] stream: {json.dumps(row)}", file=sys.stderr)
 
 
+def _guard(name: str, fn, default=None):
+    """Run one phase; on failure, log + re-probe the tunnel FRESH and
+    either continue (tunnel alive: the failure was phase-local, e.g. a
+    Mosaic 500) or raise (tunnel gone: every later phase would just burn
+    minutes timing out).  The 07-31 18:55 window died with zero engine
+    rows because one phase crash unwound the whole sweep."""
+    try:
+        return fn()
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:
+        print(f"[opp] phase {name} FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        from locust_tpu import backend as _b
+
+        for marker in (_b._PROBE_OK_MARKER, _b._PROBE_FAIL_MARKER):
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+        ok, detail = _b.probe_tpu(timeout_s=60, retries=1)
+        if not ok:
+            raise RuntimeError(
+                f"tunnel gone after phase {name}: {detail}"
+            ) from e
+        print(f"[opp] tunnel still up ({detail}); continuing past {name}",
+              file=sys.stderr)
+        return default
+
+
 def run_phases() -> None:
     """Phases 2.5 -> 4, decision-driving A/Bs FIRST: the engine sort-mode
     A/B (which steers the next driver bench via evidence tuning, and is
     the bitonic kernel's engine-level verdict) must land before the
     informational stage-parity tables — a short window that closes
     mid-sweep should leave the rows that change behavior, not the ones
-    that only describe it."""
-    rows_ab, corpus_bytes, kw, epl = _staged_rows()
+    that only describe it.  Each phase is guarded: a phase-local crash
+    skips to the next phase on a known-live tunnel (fallback params are
+    the committed evidence-tuned config) instead of abandoning the
+    window."""
+    staged = _guard("staging", _staged_rows)
+    if staged is None:
+        # Staging failed on a live tunnel (bad corpus override, loader
+        # OOM): the row-dependent phases can't run, but these three take
+        # no staged rows and can still leave evidence for the window.
+        _guard("stage_device_time", phase_stage_device_time)
+        _guard("stage_parity", phase_stage_parity)
+        _guard("stream", phase_stream)
+        return
+    rows_ab, corpus_bytes, kw, epl = staged
     caps = {"key_width": kw, "emits_per_line": epl}
-    winner = phase_sort_mode_ab(rows_ab, corpus_bytes, caps=caps)
-    best_bl, best_blocks = phase_block_lines(
-        rows_ab, corpus_bytes, sort_mode=winner, caps=caps
+    winner = _guard(
+        "sort_mode_ab",
+        lambda: phase_sort_mode_ab(rows_ab, corpus_bytes, caps=caps),
+        default_sort_mode("tpu"),
     )
-    best_ts = phase_table_ab(rows_ab, corpus_bytes, sort_mode=winner,
-                             block_lines=best_bl, caps=caps,
-                             blocks=best_blocks)
-    phase_pallas_ab(rows_ab, corpus_bytes, sort_mode=winner,
-                    block_lines=best_bl, caps=caps, blocks=best_blocks,
-                    table_size=best_ts)
+    bl = _guard(
+        "block_lines",
+        lambda: phase_block_lines(rows_ab, corpus_bytes, sort_mode=winner,
+                                  caps=caps),
+        (65536, None),  # committed block A/B winner (block_lines_ab 07-31)
+    )
+    best_bl, best_blocks = bl
+    best_ts = _guard(
+        "table_ab",
+        lambda: phase_table_ab(rows_ab, corpus_bytes, sort_mode=winner,
+                               block_lines=best_bl, caps=caps,
+                               blocks=best_blocks),
+    )
+    _guard(
+        "pallas_ab",
+        lambda: phase_pallas_ab(rows_ab, corpus_bytes, sort_mode=winner,
+                                block_lines=best_bl, caps=caps,
+                                blocks=best_blocks, table_size=best_ts),
+    )
     # VERDICT r4 order: measured utilization (#4) and the device-vs-
     # tunnel decomposition (#5) before the informational tables.  The
     # decomposition runs FIRST: jax.profiler has never run against the
     # axon remote plugin, and an in-C hang there (unkillable in-process)
     # would otherwise cost the window every later phase — ordinary
     # compiles are the known-safe risk.
-    phase_stage_device_time()
-    phase_profile(rows_ab, corpus_bytes, sort_mode=winner,
-                  block_lines=best_bl, caps=caps, table_size=best_ts)
-    phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode=winner,
-                          block_lines=best_bl, caps=caps,
-                          table_size=best_ts)
-    phase_stage_parity()
-    phase_emits_ab(rows_ab, corpus_bytes, key_width=kw)
-    phase_key_width_ab(rows_ab, corpus_bytes)
-    phase_stream()
+    _guard("stage_device_time", phase_stage_device_time)
+    _guard(
+        "profile",
+        lambda: phase_profile(rows_ab, corpus_bytes, sort_mode=winner,
+                              block_lines=best_bl, caps=caps,
+                              table_size=best_ts),
+    )
+    _guard(
+        "stage_breakdown",
+        lambda: phase_stage_breakdown(rows_ab, corpus_bytes,
+                                      sort_mode=winner,
+                                      block_lines=best_bl, caps=caps,
+                                      table_size=best_ts),
+    )
+    _guard("stage_parity", phase_stage_parity)
+    _guard("emits_ab",
+           lambda: phase_emits_ab(rows_ab, corpus_bytes, key_width=kw))
+    _guard("key_width_ab",
+           lambda: phase_key_width_ab(rows_ab, corpus_bytes))
+    _guard("stream", phase_stream)
 
 
 def main() -> int:

@@ -250,6 +250,12 @@ def main() -> int:
         row = {"check": "bitonic_rescue", "rungs": rescue}
         print(json.dumps(row), flush=True)
         artifacts.record("tpu_check", row)
+    # Battery-complete marker: the sweep's session-skip keys on THIS row
+    # (not the per-check crumbs above), so a battery killed mid-run is
+    # re-attempted next window instead of counting as answered.
+    row = {"check": "battery_complete"}
+    print(json.dumps(row), flush=True)
+    artifacts.record("tpu_check", row)
     return 0
 
 

@@ -28,6 +28,51 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _answered_variant_letters(floor_ts: float) -> set:
+    """Variant letters measured (a ``run_ms`` recorded) in any TPU
+    sort_variants row at/after ``floor_ts`` — across rows, so a window
+    that died mid-phase still retires the variants it DID measure and
+    the next window re-pays only the remainder's tunnel compiles."""
+    from locust_tpu.utils.artifacts import ledger_rows
+
+    answered = set()
+    for r in ledger_rows():
+        if r.get("kind") != "sort_variants" or r.get("backend") != "tpu":
+            continue
+        try:
+            if float(r.get("ts") or 0) < floor_ts:
+                continue
+        except (TypeError, ValueError):
+            continue
+        for name, res in (r.get("variants") or {}).items():
+            if isinstance(res, dict) and "run_ms" in res:
+                answered.add(str(name).split("_")[0])
+    return answered
+
+
+def _run_phase(name: str, cmd: list, env: dict, timeout: float) -> None:
+    """One subprocess phase; a timeout or crash here must not kill the
+    phases behind it (a 560s variant overrun crashed the whole 07-31
+    sweep before the engine A/Bs — the window's highest-value phases)."""
+    try:
+        r = subprocess.run(cmd, env=env, timeout=timeout,
+                           capture_output=True, text=True)
+        print(r.stdout, file=sys.stderr)
+        if r.returncode != 0:
+            print(f"[opp] {name} failed: {r.stderr[-500:]}", file=sys.stderr)
+    except subprocess.TimeoutExpired as e:
+        for stream in (e.stdout, e.stderr):
+            s = stream or b""
+            if isinstance(s, bytes):
+                s = s.decode(errors="replace")
+            if s.strip():
+                # stderr carries the only clue WHICH step overran
+                # (Mosaic error text, tracebacks) — keep its tail.
+                print(s[-2000:], file=sys.stderr)
+        print(f"[opp] {name} timed out after {timeout:.0f}s "
+              f"(rows already appended stay; moving on)", file=sys.stderr)
+
+
 def main() -> int:
     import opp_resume
 
@@ -42,26 +87,66 @@ def main() -> int:
     # headline), K = the MXU-histogram backup for the same role, H = the
     # Pallas bitonic kernel, C = the payload-carry incumbent, then the
     # rest; radix (E/F) last — already measured losers (2.5-3x), only
-    # re-timed if the window is generous.
-    env["LOCUST_SORT_VARIANTS"] = "J,K,H,I,G,C,B,D,E,F"
+    # re-timed if the window is generous.  Once a window has answered
+    # J/K/H (a TPU row covering them, < 24h old), later windows in the
+    # same session skip straight to the engine phases — each variant
+    # costs a fresh 10-100s tunnel compile, and re-answering a settled
+    # primitive question starves the end-to-end A/Bs behind it.
     env["N"] = str(65536 + 32768 * 20)
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "bench_sort_variants.py"),
-         "--backend", "tpu"],
-        env=env, timeout=560, capture_output=True, text=True,
-    )
-    print(r.stdout, file=sys.stderr)
-    if r.returncode != 0:
-        print(f"[opp] sort variants failed: {r.stderr[-500:]}", file=sys.stderr)
+    import time as _t
+
+    # "Answered" is SESSION-scoped, not wall-clock: the farm loop stamps
+    # its own start time into LOCUST_SESSION_TS, so only rows produced by
+    # THIS session's windows retire a phase — a committed ledger row from
+    # yesterday (same machine or pulled via git) must not suppress fresh
+    # primitive evidence after the code may have changed.  Manual runs
+    # without the stamp fall back to a 24h recency window.
+    from locust_tpu.utils.artifacts import latest_row_ts
+
+    try:
+        session_ts = float(os.environ.get("LOCUST_SESSION_TS", 0) or 0)
+    except (TypeError, ValueError):
+        session_ts = 0.0  # mistyped stamp must not cost the window
+    floor_ts = max(session_ts, _t.time() - 24 * 3600)
+    priority = ("J", "K", "H", "I", "G", "C", "B", "D", "E", "F")
+    answered = _answered_variant_letters(floor_ts)
+    if not {"J", "K", "H"} - answered:
+        # The open questions are measured; the also-rans alone don't
+        # justify re-paying a window's tunnel compiles.
+        print("[opp] sort variants already answered this session "
+              f"(answered: {sorted(answered)}); skipping", file=sys.stderr)
+    else:
+        # Only the UNANSWERED variants, priority order preserved: a
+        # window that died after measuring J and K must spend its
+        # successor's compiles on H, not on re-measuring J and K.
+        env["LOCUST_SORT_VARIANTS"] = ",".join(
+            v for v in priority if v not in answered
+        )
+        print(f"[opp] sort variants remaining: {env['LOCUST_SORT_VARIANTS']}",
+              file=sys.stderr)
+        _run_phase(
+            "sort variants",
+            [sys.executable,
+             os.path.join(REPO, "scripts", "bench_sort_variants.py"),
+             "--backend", "tpu"],
+            env, 560,
+        )
 
     # Phase 2: Pallas check battery (separate process: own jit namespace).
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "tpu_checks.py")],
-        timeout=560, capture_output=True, text=True,
-    )
-    print(r.stdout, file=sys.stderr)
-    if r.returncode != 0:
-        print(f"[opp] tpu_checks failed: {r.stderr[-500:]}", file=sys.stderr)
+    # Only the battery-COMPLETE marker retires it: tpu_checks appends one
+    # row per check, and a battery killed mid-run leaves crumb rows that
+    # must not suppress the unrun checks next window.
+    if latest_row_ts(
+        "tpu_check", where=lambda r: r.get("check") == "battery_complete"
+    ) >= floor_ts:
+        print("[opp] tpu_checks already answered this session; skipping",
+              file=sys.stderr)
+    else:
+        _run_phase(
+            "tpu_checks",
+            [sys.executable, os.path.join(REPO, "scripts", "tpu_checks.py")],
+            dict(os.environ), 560,
+        )
 
     # Phases 2.5 -> 4 are shared with the window-resume entry point
     # (scripts/opp_resume.py) so the two sweeps can never diverge.

@@ -21,6 +21,9 @@ def _load(monkeypatch, tmp_path):
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    # farm_loop PINS every ledger read to LEDGER (the git-commit target)
+    # so $LOCUST_ARTIFACTS_DIR can never diverge the harvest schedule
+    # from the committed evidence — repointing LEDGER is the only knob.
     monkeypatch.setattr(mod, "LEDGER", str(tmp_path / "tpu_runs.jsonl"))
     return mod
 
@@ -118,3 +121,40 @@ def test_next_ab_bytes_second_source_schedule(monkeypatch, tmp_path):
     done64 = dict(done32, corpus_mb=67.1)
     write([done32, done8, done64])
     assert m.next_ab_bytes() == 32 << 20  # full cycle -> re-anchor headline
+
+
+def test_farm_loop_import_is_jax_free(monkeypatch, tmp_path):
+    """The supervisor must never import jax in-process: a wedged axon
+    tunnel hangs any process that touches a jax backend, and the farm
+    loop outlives every window.  Run the import in a clean subprocess
+    (this suite's own process already has jax loaded)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import importlib.util, sys, os\n"
+         f"sys.path.insert(0, {REPO!r})\n"
+         "spec = importlib.util.spec_from_file_location(\n"
+         f"    'farm_loop', os.path.join({REPO!r}, 'scripts', 'farm_loop.py'))\n"
+         "m = importlib.util.module_from_spec(spec)\n"
+         "spec.loader.exec_module(m)\n"
+         "assert 'jax' not in sys.modules, 'farm_loop imported jax'\n"
+         "print('ok')"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0 and "ok" in r.stdout, r.stderr[-500:]
+
+
+def test_farm_loop_reads_pinned_to_ledger(monkeypatch, tmp_path):
+    """$LOCUST_ARTIFACTS_DIR must NOT steer farm_loop's reads: the
+    harvest schedule and the git-committed evidence are the same file by
+    construction."""
+    m = _load(monkeypatch, tmp_path)
+    with open(m.LEDGER, "w") as f:
+        f.write(json.dumps(
+            {"kind": "bench", "backend": "tpu", "ts": 123.0}) + "\n")
+    other = tmp_path / "other"
+    other.mkdir()
+    (other / "tpu_runs.jsonl").write_text(json.dumps(
+        {"kind": "bench", "backend": "tpu", "ts": 999.0}) + "\n")
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(other))
+    assert m.latest_ts("bench") == 123.0  # pinned, not 999.0

@@ -60,3 +60,143 @@ def test_get_engine_memoizes_per_config():
     e3 = m.get_engine(EngineConfig(block_lines=128, key_width=16,
                                    emits_per_line=8))
     assert e3 is not e1
+
+
+def _stub_probe(monkeypatch, tmp_path, ok: bool):
+    """Stub the probe AND point the marker paths at tmp — _guard unlinks
+    the live probe cache before re-probing, and a suite run during a farm
+    session must never wipe the real markers (that forces the next farm
+    probe to re-pay 60-120s, or hang on a wedged tunnel)."""
+    from locust_tpu import backend as b
+
+    monkeypatch.setattr(b, "_PROBE_OK_MARKER", str(tmp_path / "ok"))
+    monkeypatch.setattr(b, "_PROBE_FAIL_MARKER", str(tmp_path / "fail"))
+    monkeypatch.setattr(b, "probe_tpu", lambda **kw: (ok, "stub"))
+
+
+def test_guard_returns_default_when_tunnel_alive(monkeypatch, tmp_path):
+    """A phase-local crash must not unwind the sweep while the tunnel is
+    verifiably still up (the 07-31 18:55 window lost every engine phase
+    to one subprocess timeout): _guard eats the exception, returns the
+    fallback, and the next phase proceeds."""
+    m = _load()
+    _stub_probe(monkeypatch, tmp_path, ok=True)
+
+    def boom():
+        raise ValueError("mosaic 500")
+
+    assert m._guard("boom", boom, default="fallback") == "fallback"
+
+
+def test_guard_raises_when_tunnel_gone(monkeypatch, tmp_path):
+    """Same crash with the tunnel dead must abort the sweep — later
+    phases would each burn minutes of a closed window timing out."""
+    import pytest
+
+    m = _load()
+    _stub_probe(monkeypatch, tmp_path, ok=False)
+
+    def boom():
+        raise ValueError("tunnel reset")
+
+    with pytest.raises(RuntimeError, match="tunnel gone"):
+        m._guard("boom", boom)
+
+
+def test_sweep_latest_ts_requires_full_variant_coverage(tmp_path, monkeypatch):
+    """The variant-phase skip must only fire on a row that actually
+    answered the priority questions (J/K/H) — a crumb row with one
+    variant must not retire the phase."""
+    import importlib.util
+    import json
+    import time
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_opp_under_test", os.path.join(REPO, "scripts",
+                                           "tpu_opportunistic.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    ok = {"compile_s": 1.0, "run_ms": 5.0}
+    rows = [
+        # Crumb: one variant only.
+        {"ts": now, "kind": "sort_variants", "backend": "tpu",
+         "variants": {"J_scatter_agg": ok}},
+        # All three present but H errored (the Mosaic-crash shape):
+        # must NOT count as answered.
+        {"ts": now - 30, "kind": "sort_variants", "backend": "tpu",
+         "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
+                      "H_bitonic_pallas": {"error": "mosaic 500"}}},
+        # Full coverage, every required variant measured.
+        {"ts": now - 60, "kind": "sort_variants", "backend": "tpu",
+         "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
+                      "H_bitonic_pallas": ok}},
+    ]
+    (led / "tpu_runs.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    # Cross-row union of MEASURED letters at/after the floor; errored
+    # variants (the Mosaic-crash shape) never count as answered.
+    assert mod._answered_variant_letters(now - 120) == {"J", "K", "H"}
+    # The errored-H row alone (floor excludes the complete row): J, K
+    # answered, H still open -> the phase re-runs with H first.
+    assert mod._answered_variant_letters(now - 45) == {"J", "K"}
+
+
+def test_ledger_reader_survives_malformed_rows(tmp_path, monkeypatch):
+    """The ledger is multi-writer and git-merged: null/garbage ts, bare
+    scalars, and torn JSON must all be skipped, never raised on — one
+    bad line must not cost a tunnel window (code review, r5)."""
+    import json
+    import time
+
+    from locust_tpu.utils.artifacts import latest_row_ts, ledger_rows
+
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    lines = [
+        json.dumps({"ts": None, "kind": "bench", "backend": "tpu"}),
+        json.dumps({"ts": "not-a-number", "kind": "bench",
+                    "backend": "tpu"}),
+        json.dumps(["not", "a", "dict"]),
+        '{"torn": ',
+        json.dumps({"ts": now, "kind": "bench", "backend": "tpu"}),
+    ]
+    (led / "tpu_runs.jsonl").write_text("\n".join(lines) + "\n")
+    assert len(ledger_rows()) == 3  # two dict rows + the malformed-ts one
+    assert latest_row_ts("bench") == now
+    # A predicate that raises must skip the row, not crash the scan.
+    assert latest_row_ts(
+        "bench", where=lambda r: r["missing-key"]
+    ) == 0.0
+
+
+def test_tpu_checks_skip_requires_battery_complete(tmp_path, monkeypatch):
+    """Per-check crumb rows from a battery killed mid-run must not
+    retire phase 2 — only the battery_complete marker row does."""
+    import json
+    import time
+
+    from locust_tpu.utils.artifacts import latest_row_ts
+
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    complete = lambda r: r.get("check") == "battery_complete"  # noqa: E731
+    (led / "tpu_runs.jsonl").write_text(
+        json.dumps({"ts": now, "kind": "tpu_check", "backend": "tpu",
+                    "check": "tokenize_ab"}) + "\n"
+    )
+    assert latest_row_ts("tpu_check", where=complete) == 0.0
+    with open(led / "tpu_runs.jsonl", "a") as f:
+        f.write(json.dumps({"ts": now + 1, "kind": "tpu_check",
+                            "backend": "tpu",
+                            "check": "battery_complete"}) + "\n")
+    assert latest_row_ts("tpu_check", where=complete) == now + 1
