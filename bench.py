@@ -128,24 +128,15 @@ def error_payload(msg: str) -> dict:
 
 
 def _tpu_rows(kind: str) -> list[dict]:
-    """All committed TPU evidence rows of ``kind`` (artifacts/tpu_runs.jsonl)."""
+    """All committed TPU evidence rows of ``kind``, via the one shared
+    hardened ledger reader (locust_tpu.utils.artifacts)."""
     sys.path.insert(0, _HERE)
-    from locust_tpu.utils.artifacts import artifacts_dir
+    from locust_tpu.utils.artifacts import ledger_rows
 
-    path = os.path.join(artifacts_dir(), "tpu_runs.jsonl")
-    rows = []
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    row = json.loads(line)
-                except ValueError:
-                    continue
-                if row.get("kind") == kind and row.get("backend") == "tpu":
-                    rows.append(row)
-    except OSError:
-        pass
-    return rows
+    return [
+        r for r in ledger_rows()
+        if r.get("kind") == kind and r.get("backend") == "tpu"
+    ]
 
 
 def _last_tpu_bench_row() -> dict | None:

@@ -56,9 +56,14 @@ def ledger_rows(path: str | None = None) -> list[dict]:
     """
     rows: list[dict] = []
     try:
+        # errors="replace": a torn binary write or merge artifact must
+        # cost ONE line (json.loads rejects the U+FFFD), not the whole
+        # scan — UnicodeDecodeError from line iteration would otherwise
+        # escape the per-line guard and kill the farm supervisor.
         with open(
             path or os.path.join(artifacts_dir(), "tpu_runs.jsonl"),
             encoding="utf-8",
+            errors="replace",
         ) as f:
             for line in f:
                 try:

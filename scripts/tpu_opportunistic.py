@@ -28,16 +28,22 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _answered_variant_letters(floor_ts: float) -> set:
-    """Variant letters measured (a ``run_ms`` recorded) in any TPU
-    sort_variants row at/after ``floor_ts`` — across rows, so a window
-    that died mid-phase still retires the variants it DID measure and
-    the next window re-pays only the remainder's tunnel compiles."""
+def _answered_variant_letters(floor_ts: float, n_rows: int) -> set:
+    """Variant letters measured (a ``run_ms`` recorded) in a TPU
+    sort_variants row at/after ``floor_ts`` AT THE SWEEP'S SHAPE —
+    across rows, so a window that died mid-phase still retires the
+    variants it DID measure and the next window re-pays only the
+    remainder's tunnel compiles.  The ``n_rows`` filter keeps a manual
+    small-N spot-check (primitive timings are strongly shape-dependent;
+    J measured 19x at 65k rows vs 2.2x at 720k) from standing in for
+    the fold-true-shape verdict."""
     from locust_tpu.utils.artifacts import ledger_rows
 
     answered = set()
     for r in ledger_rows():
         if r.get("kind") != "sort_variants" or r.get("backend") != "tpu":
+            continue
+        if r.get("n_rows") != n_rows:
             continue
         try:
             if float(r.get("ts") or 0) < floor_ts:
@@ -92,7 +98,8 @@ def main() -> int:
     # same session skip straight to the engine phases — each variant
     # costs a fresh 10-100s tunnel compile, and re-answering a settled
     # primitive question starves the end-to-end A/Bs behind it.
-    env["N"] = str(65536 + 32768 * 20)
+    sweep_n = 65536 + 32768 * 20
+    env["N"] = str(sweep_n)
     import time as _t
 
     # "Answered" is SESSION-scoped, not wall-clock: the farm loop stamps
@@ -109,7 +116,7 @@ def main() -> int:
         session_ts = 0.0  # mistyped stamp must not cost the window
     floor_ts = max(session_ts, _t.time() - 24 * 3600)
     priority = ("J", "K", "H", "I", "G", "C", "B", "D", "E", "F")
-    answered = _answered_variant_letters(floor_ts)
+    answered = _answered_variant_letters(floor_ts, sweep_n)
     if not {"J", "K", "H"} - answered:
         # The open questions are measured; the also-rans alone don't
         # justify re-paying a window's tunnel compiles.

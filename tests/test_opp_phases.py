@@ -121,30 +121,41 @@ def test_sweep_latest_ts_requires_full_variant_coverage(tmp_path, monkeypatch):
     led.mkdir()
     monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
     now = time.time()
+    N = 65536 + 32768 * 20  # the sweep's fixed fold-true shape
     ok = {"compile_s": 1.0, "run_ms": 5.0}
     rows = [
         # Crumb: one variant only.
         {"ts": now, "kind": "sort_variants", "backend": "tpu",
-         "variants": {"J_scatter_agg": ok}},
+         "n_rows": N, "variants": {"J_scatter_agg": ok}},
         # All three present but H errored (the Mosaic-crash shape):
         # must NOT count as answered.
         {"ts": now - 30, "kind": "sort_variants", "backend": "tpu",
+         "n_rows": N,
          "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
                       "H_bitonic_pallas": {"error": "mosaic 500"}}},
         # Full coverage, every required variant measured.
         {"ts": now - 60, "kind": "sort_variants", "backend": "tpu",
+         "n_rows": N,
          "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
                       "H_bitonic_pallas": ok}},
+        # Fresh but at a SPOT-CHECK shape: a manual small-N run must not
+        # stand in for the fold-true-shape verdict (primitive timings
+        # are strongly shape-dependent).
+        {"ts": now, "kind": "sort_variants", "backend": "tpu",
+         "n_rows": 65536,
+         "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
+                      "H_bitonic_pallas": ok, "E_radix4x8": ok}},
     ]
     (led / "tpu_runs.jsonl").write_text(
         "".join(json.dumps(r) + "\n" for r in rows)
     )
     # Cross-row union of MEASURED letters at/after the floor; errored
-    # variants (the Mosaic-crash shape) never count as answered.
-    assert mod._answered_variant_letters(now - 120) == {"J", "K", "H"}
+    # variants (the Mosaic-crash shape) never count as answered, and the
+    # off-shape row contributes nothing (no E in the union).
+    assert mod._answered_variant_letters(now - 120, N) == {"J", "K", "H"}
     # The errored-H row alone (floor excludes the complete row): J, K
     # answered, H still open -> the phase re-runs with H first.
-    assert mod._answered_variant_letters(now - 45) == {"J", "K"}
+    assert mod._answered_variant_letters(now - 45, N) == {"J", "K"}
 
 
 def test_ledger_reader_survives_malformed_rows(tmp_path, monkeypatch):
@@ -169,6 +180,11 @@ def test_ledger_reader_survives_malformed_rows(tmp_path, monkeypatch):
         json.dumps({"ts": now, "kind": "bench", "backend": "tpu"}),
     ]
     (led / "tpu_runs.jsonl").write_text("\n".join(lines) + "\n")
+    # A torn BINARY write (invalid UTF-8) must cost one line, not the
+    # whole scan: UnicodeDecodeError is a ValueError, not an OSError,
+    # so it would escape the old except clause (code review, r5).
+    with open(led / "tpu_runs.jsonl", "ab") as f:
+        f.write(b"\xff\xfe torn binary line \x00\xff\n")
     assert len(ledger_rows()) == 3  # two dict rows + the malformed-ts one
     assert latest_row_ts("bench") == now
     # A predicate that raises must skip the row, not crash the scan.
