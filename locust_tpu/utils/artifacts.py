@@ -101,6 +101,67 @@ def latest_row_ts(
     return ts
 
 
+_CODE_FP: str | None = None
+
+
+def code_fingerprint() -> str:
+    """Hash of the measurement-relevant package code: core/ops/parallel/
+    io trees plus engine/config/backend.  Evidence rows are stamped with
+    it so session-resume logic can tell "same code, reusable
+    measurement" from "the compute path changed mid-session, re-measure"
+    — a wall-clock floor alone cannot (a carried stale side would steer
+    bench's evidence tuning with numbers from two code versions).
+    Measurement IMPLEMENTATIONS outside the package are in the hash too:
+    the variant kernels (scripts/bench_sort_variants.py), the check
+    battery (scripts/tpu_checks.py), and bench.py's corpus/config policy
+    — editing a measured kernel must invalidate its rows.  utils/ and
+    the orchestration scripts (farm loop, sweep drivers) stay OUTSIDE:
+    ledger/scheduling changes do not alter what a measurement means, and
+    including them would invalidate same-code evidence on every
+    instrumentation commit.  Paths hashed relative to the repo so the
+    fingerprint is machine-portable."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        import hashlib
+
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        repo = os.path.dirname(pkg)
+        files: list[str] = []
+        for d in ("core", "ops", "parallel", "io"):
+            for root, _, names in os.walk(os.path.join(pkg, d)):
+                files.extend(
+                    os.path.join(root, n) for n in names if n.endswith(".py")
+                )
+        files.extend(
+            os.path.join(pkg, n)
+            for n in ("engine.py", "config.py", "backend.py")
+        )
+        files.extend(
+            os.path.join(repo, p)
+            for p in ("bench.py",
+                      os.path.join("scripts", "bench_sort_variants.py"),
+                      os.path.join("scripts", "tpu_checks.py"),
+                      # opp_resume holds the engine-A/B timing methodology
+                      # (rep counts, warm/compile boundary) — editing it
+                      # changes what a row's numbers MEAN, so it must
+                      # invalidate them, even though it also carries
+                      # orchestration whose edits are harmless.
+                      os.path.join("scripts", "opp_resume.py"))
+        )
+        h = hashlib.sha1()
+        for p in sorted(files):
+            try:
+                with open(p, "rb") as f:
+                    h.update(os.path.relpath(p, repo).encode())
+                    h.update(b"\0")
+                    h.update(f.read())
+                    h.update(b"\0")
+            except OSError:
+                continue
+        _CODE_FP = h.hexdigest()[:12]
+    return _CODE_FP
+
+
 def on_tpu() -> bool:
     """True iff jax is initialized on a non-CPU backend.
 
@@ -133,6 +194,7 @@ def record(kind: str, payload: dict, force: bool = False) -> bool:
             if jax.devices()
             else "unknown",
             "jax": jax.__version__,
+            "code": code_fingerprint(),
             **payload,
         }
     except Exception as e:  # pragma: no cover - evidence must never break a run

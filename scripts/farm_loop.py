@@ -6,9 +6,11 @@ up, captures in strict value order:
 
   1. a fresh headline bench (``python bench.py`` — evidence-tuned config,
      appends a ``kind: bench`` row) unless one landed within the last hour
-  2. the full decision sweep (``scripts/tpu_opportunistic.py``: sort
-     variants, Pallas check battery, engine sort-mode/block/pallas A/Bs,
-     stage parity, caps A/Bs) — includes the bitonic kernel verdict
+  2. the full decision sweep (``scripts/tpu_opportunistic.py``: unmeasured
+     sort variants -> engine sort-mode/block/table/pallas A/Bs + stage
+     decomposition/profiler/parity -> Pallas check battery last) —
+     includes the hasht and bitonic kernel verdicts; session-answered
+     phases are skipped so each window spends compiles on open questions
   3. the 512MB bounded-RSS streaming phase, once per session
   4. auto-commits ``artifacts/tpu_runs.jsonl`` (pathspec-only commit, so
      it cannot sweep up unrelated working-tree edits)
@@ -30,7 +32,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LEDGER = os.path.join(REPO, "artifacts", "tpu_runs.jsonl")
 PROFILES = os.path.join(REPO, "artifacts", "profiles")
-SESSION_TS = time.time()  # farm start: floor for the sweep's phase skips
+# Session floor for the sweep's already-answered skips.  Defaults to
+# farm start; an explicit LOCUST_SESSION_TS pins it across farm RESTARTS
+# within one build session — otherwise every restart would orphan the
+# evidence captured before it and the next window would re-pay those
+# compiles (observed 07-31: an 18:43 window's 8 variant rows predated a
+# 19:48 farm restart's stamp).
+try:
+    SESSION_TS = float(os.environ.get("LOCUST_SESSION_TS") or 0) or time.time()
+except (TypeError, ValueError):
+    SESSION_TS = time.time()
 
 sys.path.insert(0, REPO)
 # The one hardened ledger reader.  This import chain is jax-free

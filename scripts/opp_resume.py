@@ -363,6 +363,91 @@ def _staged_rows():
     return rows, corpus_bytes, kw, epl
 
 
+def _session_floor() -> float:
+    """Rows at/after this ts count as THIS session's evidence (farm loop
+    stamps LOCUST_SESSION_TS; manual runs fall back to 24h)."""
+    try:
+        session_ts = float(os.environ.get("LOCUST_SESSION_TS", 0) or 0)
+    except (TypeError, ValueError):
+        session_ts = 0.0
+    return max(session_ts, time.time() - 24 * 3600)
+
+
+def _session_row_ok(r: dict) -> bool:
+    """Is this ledger row reusable evidence for the CURRENT session?
+
+    Primary key: the measurement-code fingerprint — a row stamped with
+    the current ``code`` was produced by the same compute path, so its
+    numbers are commensurable with anything this session measures (and a
+    row from a DIFFERENT fingerprint must re-run even if minutes old:
+    carrying it would hand bench's evidence tuning a comparison across
+    two code versions).  The row's ``jax`` version must also match this
+    process's — an XLA upgrade changes codegen without touching our
+    code.  Legacy rows without the code stamp fall back to the
+    session-ts floor.  Everything is additionally bounded to 24h — a
+    same-code row from last week shouldn't silently stand in for a
+    window that could re-anchor it.  The ONE validity rule for every
+    already-answered skip (variants, battery, engine-mode carry); both
+    sweep entry points import it from here."""
+    from locust_tpu.utils.artifacts import code_fingerprint
+
+    try:
+        ts = float(r.get("ts") or 0)
+    except (TypeError, ValueError):
+        return False
+    if ts < time.time() - 24 * 3600:
+        return False
+    try:
+        import jax
+
+        if r.get("jax") not in (None, jax.__version__):
+            return False
+    except Exception:  # pragma: no cover - jax import must not gate reads
+        pass
+    code = r.get("code")
+    if code is not None:
+        return code == code_fingerprint()
+    return ts >= _session_floor()
+
+
+def _prior_mode_results(corpus_mb: float, caps) -> dict:
+    """Session-fresh MEASURED sort-mode results at exactly this corpus
+    shape and caps, unioned across ledger rows.  A window that died
+    after hasht's compile must not make the next window re-pay it —
+    mode-level resume, same idea as the variant-letter resume in
+    tpu_opportunistic.  Only sides with an ``mb_s`` carry (errored modes
+    re-run); shape and caps must match so an 8MB second-source row can
+    never masquerade as headline-shape evidence."""
+    from locust_tpu.utils.artifacts import ledger_rows
+
+    out: dict = {}
+    for r in ledger_rows():
+        if (r.get("kind") != "engine_sort_mode_ab"
+                or r.get("backend") != "tpu"):
+            continue
+        if not _session_row_ok(r):
+            continue
+        if r.get("corpus_mb") != corpus_mb or r.get("caps") != caps:
+            continue
+        try:
+            row_ts = float(r.get("ts") or 0)
+        except (TypeError, ValueError):
+            continue
+        for m, res in (r.get("modes") or {}).items():
+            # Only FIRST-HAND measurements carry: a side that was itself
+            # carried (tagged below) must not chain — re-recording a
+            # carried number under a fresh ts would otherwise renew its
+            # 24h validity forever, laundering a never-re-measured
+            # result past the re-anchor bound.  Duplicates resolve by
+            # NEWEST source ts, not file order: the ledger is
+            # multi-writer and git-merged, so line order is meaningless.
+            if (isinstance(res, dict) and "mb_s" in res
+                    and "carried_from" not in res
+                    and row_ts >= out.get(m, {}).get("carried_from", 0.0)):
+                out[m] = {**res, "carried_from": row_ts}
+    return out
+
+
 def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
     """Engine end-to-end per sort mode at bench shapes.
 
@@ -375,8 +460,17 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
-    results = {}
+    corpus_mb = round(corpus_bytes / 1e6, 1)
+    results = {
+        m: r for m, r in _prior_mode_results(corpus_mb, caps).items()
+        if m in AB_SORT_MODES
+    }
+    if results:
+        print(f"[opp] sort-mode A/B resuming; already measured this "
+              f"session: {sorted(results)}", file=sys.stderr)
     for mode in AB_SORT_MODES:
+        if mode in results:
+            continue
         try:
             eng = get_engine(
                 bench.bench_engine_config(32768, sort_mode=mode, **(caps or {}))
@@ -424,9 +518,9 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
         # joint caps rule).
         artifacts.record(
             "engine_sort_mode_ab",
-            {"corpus_mb": round(corpus_bytes / 1e6, 1), "caps": caps,
+            {"corpus_mb": corpus_mb, "caps": caps,
              "modes": dict(results),
-             "partial": mode != AB_SORT_MODES[-1]},
+             "partial": any(m not in results for m in AB_SORT_MODES)},
         )
     winner = max(results, key=lambda m: results[m].get("mb_s", -1.0))
     if "mb_s" not in results[winner]:

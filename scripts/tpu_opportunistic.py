@@ -3,14 +3,18 @@
 The remote-TPU tunnel flaps (VERDICT r2 missing #1); this script is the
 one-shot "the tunnel is up, capture everything" bundle.  Each phase appends
 rows to ``artifacts/tpu_runs.jsonl`` via locust_tpu.utils.artifacts, so a
-partial window still leaves committed evidence.  Phases, cheapest first:
+partial window still leaves committed evidence.  Phase order is by
+DECISION VALUE per compile-second (each already-session-answered phase is
+skipped, see _session_row_ok):
 
-  1. sort-variant bench at the engine's true Process-stage shape
-     (B-G; A_lex9 is skipped — its XLA compile alone outlasts windows)
-  2. the Pallas tokenizer check battery (scripts/tpu_checks.py inline)
-  3. engine end-to-end A/B across sort modes at bench shapes
-  4. (optional, $LOCUST_OPP_STREAM_MB) big-corpus streaming run in bounded
-     RSS — the north-star-scale check that is throughput-infeasible on CPU
+  1. sort-variant bench at the engine's true Process-stage shape —
+     only the variants this session hasn't measured yet
+  2. the shared opp_resume phases: engine sort-mode A/B (hasht verdict,
+     steers bench's evidence tuning) -> block/table/pallas A/Bs ->
+     stage device-time decomposition -> profiler capture -> parity
+     tables -> (optional, $LOCUST_OPP_STREAM_MB) bounded-RSS streaming
+  3. the Pallas check battery (scripts/tpu_checks.py subprocess) —
+     fused/tile ladders + tokenize checks, the window's long tail
 
 Exit codes: 0 = all requested phases captured, 3 = tunnel down, 1 = error.
 """
@@ -27,28 +31,32 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Sibling module: ensure the scripts dir is importable even when THIS
+# module is loaded by file path (tests) rather than executed as a script.
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS_DIR not in sys.path:
+    sys.path.insert(0, _SCRIPTS_DIR)
 
-def _answered_variant_letters(floor_ts: float, n_rows: int) -> set:
-    """Variant letters measured (a ``run_ms`` recorded) in a TPU
-    sort_variants row at/after ``floor_ts`` AT THE SWEEP'S SHAPE —
-    across rows, so a window that died mid-phase still retires the
-    variants it DID measure and the next window re-pays only the
-    remainder's tunnel compiles.  The ``n_rows`` filter keeps a manual
-    small-N spot-check (primitive timings are strongly shape-dependent;
-    J measured 19x at 65k rows vs 2.2x at 720k) from standing in for
-    the fold-true-shape verdict."""
+import opp_resume  # noqa: E402
+
+
+def _answered_variant_letters(n_rows: int) -> set:
+    """Variant letters measured (a ``run_ms`` recorded) in a
+    session-valid TPU sort_variants row AT THE SWEEP'S SHAPE — across
+    rows, so a window that died mid-phase still retires the variants it
+    DID measure and the next window re-pays only the remainder's tunnel
+    compiles.  Session validity is ``opp_resume._session_row_ok`` (code
+    fingerprint, legacy ts-floor fallback); the ``n_rows`` filter keeps
+    a manual small-N spot-check (primitive timings are strongly
+    shape-dependent; J measured 19x at 65k rows vs 2.2x at 720k) from
+    standing in for the fold-true-shape verdict."""
     from locust_tpu.utils.artifacts import ledger_rows
 
     answered = set()
     for r in ledger_rows():
         if r.get("kind") != "sort_variants" or r.get("backend") != "tpu":
             continue
-        if r.get("n_rows") != n_rows:
-            continue
-        try:
-            if float(r.get("ts") or 0) < floor_ts:
-                continue
-        except (TypeError, ValueError):
+        if r.get("n_rows") != n_rows or not opp_resume._session_row_ok(r):
             continue
         for name, res in (r.get("variants") or {}).items():
             if isinstance(res, dict) and "run_ms" in res:
@@ -80,8 +88,6 @@ def _run_phase(name: str, cmd: list, env: dict, timeout: float) -> None:
 
 
 def main() -> int:
-    import opp_resume
-
     if not opp_resume.tunnel_gate():
         return 3
 
@@ -100,23 +106,16 @@ def main() -> int:
     # primitive question starves the end-to-end A/Bs behind it.
     sweep_n = 65536 + 32768 * 20
     env["N"] = str(sweep_n)
-    import time as _t
 
-    # "Answered" is SESSION-scoped, not wall-clock: the farm loop stamps
-    # its own start time into LOCUST_SESSION_TS, so only rows produced by
-    # THIS session's windows retire a phase — a committed ledger row from
-    # yesterday (same machine or pulled via git) must not suppress fresh
-    # primitive evidence after the code may have changed.  Manual runs
-    # without the stamp fall back to a 24h recency window.
+    # "Answered" is SESSION-scoped: primarily by measurement-code
+    # fingerprint (same compute path -> reusable row, regardless of farm
+    # restarts), with a session-ts floor for legacy unstamped rows — the
+    # ONE validity rule, opp_resume._session_row_ok, shared by both
+    # sweep entry points.
     from locust_tpu.utils.artifacts import latest_row_ts
 
-    try:
-        session_ts = float(os.environ.get("LOCUST_SESSION_TS", 0) or 0)
-    except (TypeError, ValueError):
-        session_ts = 0.0  # mistyped stamp must not cost the window
-    floor_ts = max(session_ts, _t.time() - 24 * 3600)
     priority = ("J", "K", "H", "I", "G", "C", "B", "D", "E", "F")
-    answered = _answered_variant_letters(floor_ts, sweep_n)
+    answered = _answered_variant_letters(sweep_n)
     if not {"J", "K", "H"} - answered:
         # The open questions are measured; the also-rans alone don't
         # justify re-paying a window's tunnel compiles.
@@ -139,13 +138,35 @@ def main() -> int:
             env, 560,
         )
 
-    # Phase 2: Pallas check battery (separate process: own jit namespace).
-    # Only the battery-COMPLETE marker retires it: tpu_checks appends one
-    # row per check, and a battery killed mid-run leaves crumb rows that
-    # must not suppress the unrun checks next window.
+    # Phases 2.5 -> 4 are shared with the window-resume entry point
+    # (scripts/opp_resume.py) so the two sweeps can never diverge.
+    # They run BEFORE the Pallas check battery: the engine sort-mode A/B
+    # (hasht verdict — the round's highest-expected-value unknown, and
+    # the input bench's evidence tuning adopts) must not starve behind
+    # 560s of kernel-ladder compiles whose headline deliverable (a
+    # Pallas hardware ms) the variant phase already landed.
+    opp_resume.run_phases()
+
+    # Drop the engine memo (compiled executables + any captured device
+    # buffers) before spawning the battery: on the one-chip axon backend
+    # the child's Pallas ladders allocate against whatever HBM this
+    # parent still holds — the pre-reorder sweep spawned the battery
+    # from an allocation-free parent, and that state must be restored.
+    import gc
+
+    opp_resume._ENGINES.clear()
+    gc.collect()
+
+    # Pallas check battery (separate process: own jit namespace) —
+    # fused/tile ladders + tokenize checks, the window's long tail.
+    # Only the battery-COMPLETE marker retires it: tpu_checks appends
+    # one row per check, and a battery killed mid-run leaves crumb rows
+    # that must not suppress the unrun checks next window.
     if latest_row_ts(
-        "tpu_check", where=lambda r: r.get("check") == "battery_complete"
-    ) >= floor_ts:
+        "tpu_check",
+        where=lambda r: (r.get("check") == "battery_complete"
+                         and opp_resume._session_row_ok(r)),
+    ) > 0:
         print("[opp] tpu_checks already answered this session; skipping",
               file=sys.stderr)
     else:
@@ -154,10 +175,6 @@ def main() -> int:
             [sys.executable, os.path.join(REPO, "scripts", "tpu_checks.py")],
             dict(os.environ), 560,
         )
-
-    # Phases 2.5 -> 4 are shared with the window-resume entry point
-    # (scripts/opp_resume.py) so the two sweeps can never diverge.
-    opp_resume.run_phases()
 
     print("[opp] sweep complete", file=sys.stderr)
     return 0

@@ -146,16 +146,32 @@ def test_sweep_latest_ts_requires_full_variant_coverage(tmp_path, monkeypatch):
          "variants": {"J_scatter_agg": ok, "K_mxu_hist": ok,
                       "H_bitonic_pallas": ok, "E_radix4x8": ok}},
     ]
+    from locust_tpu.utils.artifacts import code_fingerprint
+
+    # A stale-code row (fresh ts, WRONG fingerprint): measurements from
+    # a different compute path never count, however recent.
+    rows.append({"ts": now, "kind": "sort_variants", "backend": "tpu",
+                 "n_rows": N, "code": "0badc0de0000",
+                 "variants": {"F_radix6x6": ok}})
+    # A current-code row carries even if it PREDATES the session stamp
+    # (e.g. captured before a farm restart).
+    rows.append({"ts": now - 500, "kind": "sort_variants",
+                 "backend": "tpu", "n_rows": N,
+                 "code": code_fingerprint(),
+                 "variants": {"D_hash1_gather": ok}})
     (led / "tpu_runs.jsonl").write_text(
         "".join(json.dumps(r) + "\n" for r in rows)
     )
-    # Cross-row union of MEASURED letters at/after the floor; errored
-    # variants (the Mosaic-crash shape) never count as answered, and the
-    # off-shape row contributes nothing (no E in the union).
-    assert mod._answered_variant_letters(now - 120, N) == {"J", "K", "H"}
-    # The errored-H row alone (floor excludes the complete row): J, K
-    # answered, H still open -> the phase re-runs with H first.
-    assert mod._answered_variant_letters(now - 45, N) == {"J", "K"}
+    # Cross-row union of MEASURED letters; errored variants (the
+    # Mosaic-crash shape) never count as answered, the off-shape row
+    # contributes nothing (no E), the stale-code row nothing (no F),
+    # and the pre-stamp current-code row DOES carry (D).
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 120))
+    assert mod._answered_variant_letters(N) == {"J", "K", "H", "D"}
+    # Later stamp excludes the unstamped complete row (legacy floor
+    # path): J, K answered, H still open -> the phase re-runs, H first.
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 45))
+    assert mod._answered_variant_letters(N) == {"J", "K", "D"}
 
 
 def test_ledger_reader_survives_malformed_rows(tmp_path, monkeypatch):
@@ -216,3 +232,92 @@ def test_tpu_checks_skip_requires_battery_complete(tmp_path, monkeypatch):
                             "backend": "tpu",
                             "check": "battery_complete"}) + "\n")
     assert latest_row_ts("tpu_check", where=complete) == now + 1
+
+
+def test_prior_mode_results_session_and_shape_scoped(tmp_path, monkeypatch):
+    """Mode-level A/B resume: session-fresh MEASURED modes at the exact
+    (corpus_mb, caps) shape carry into the next window's phase; errored
+    modes, off-shape rows, and pre-session rows never do."""
+    import json
+    import time
+
+    m = _load()
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 600))
+    caps = {"key_width": 16, "emits_per_line": 17}
+    rows = [
+        # Session-fresh partial row: hasht measured, bitonic errored.
+        {"ts": now - 100, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": caps,
+         "modes": {"hasht": {"mb_s": 51.0, "best_s": 0.66},
+                   "bitonic": {"error": "Mosaic 500"}}},
+        # Same session, later crumb adds hashp2.
+        {"ts": now - 50, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": caps,
+         "modes": {"hashp2": {"mb_s": 57.6}}},
+        # Off-shape (8MB second-source): must not carry.
+        {"ts": now - 40, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 8.4, "caps": caps,
+         "modes": {"radix": {"mb_s": 9.0}}},
+        # Different caps: must not carry.
+        {"ts": now - 30, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": {"key_width": 32, "emits_per_line": 17},
+         "modes": {"hash": {"mb_s": 30.0}}},
+        # Pre-session (yesterday's committed evidence): must not carry.
+        {"ts": now - 7200, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": caps,
+         "modes": {"hash1": {"mb_s": 38.7}}},
+    ]
+    (led / "tpu_runs.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    carried = m._prior_mode_results(33.6, caps)
+    assert set(carried) == {"hasht", "hashp2"}, carried
+    assert carried["hasht"]["mb_s"] == 51.0
+
+
+def test_prior_mode_results_no_carry_chaining(tmp_path, monkeypatch):
+    """A carried side re-recorded under a fresh ts must not renew its
+    validity: only first-hand measurements (no carried_from tag) carry,
+    so a number can live at most one hop past its measuring window."""
+    import json
+    import time
+
+    m = _load()
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 7200))
+    caps = {"key_width": 16, "emits_per_line": 17}
+    rows = [
+        # Window A: first-hand hasht measurement.
+        {"ts": now - 3600, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": caps,
+         "modes": {"hasht": {"mb_s": 51.0}}},
+        # Window B: re-recorded row where hasht was CARRIED (tagged) and
+        # hashp2 measured first-hand.
+        {"ts": now - 60, "kind": "engine_sort_mode_ab", "backend": "tpu",
+         "corpus_mb": 33.6, "caps": caps,
+         "modes": {"hasht": {"mb_s": 51.0, "carried_from": now - 3600},
+                   "hashp2": {"mb_s": 57.6}}},
+    ]
+    (led / "tpu_runs.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    carried = m._prior_mode_results(33.6, caps)
+    # hasht carries from window A (first-hand), hashp2 from window B;
+    # window B's tagged hasht contributes nothing.
+    assert set(carried) == {"hasht", "hashp2"}
+    assert carried["hasht"]["carried_from"] == now - 3600
+    # Once window A ages past 24h, ONLY the first-hand hashp2 remains —
+    # the tag stops the laundering chain.
+    rows[0]["ts"] = now - 25 * 3600
+    (led / "tpu_runs.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    carried = m._prior_mode_results(33.6, caps)
+    assert set(carried) == {"hashp2"}, carried
