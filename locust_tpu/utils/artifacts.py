@@ -40,6 +40,21 @@ def artifacts_dir() -> str:
     return os.environ.get("LOCUST_ARTIFACTS_DIR", _DEFAULT_DIR)
 
 
+# Ledger kinds whose rows DRIVE bench.py's evidence-tuned configuration
+# (bench._evidence_tuned_tpu_defaults reads exactly these).  Shared here
+# (jax-free) so the farm loop's bench-staleness check and bench's tuning
+# can never drift: a kind added to one but not the other either leaves
+# the committed headline stale or burns windows re-running an unchanged
+# config.  emits_per_line_ab / key_width_ab are deliberately absent —
+# they are verification phases; bench auto-sizes caps from the corpus.
+CONFIG_AB_KINDS = (
+    "engine_sort_mode_ab",
+    "block_lines_ab",
+    "engine_table_ab",
+    "engine_pallas_ab",
+)
+
+
 def ledger_rows(path: str | None = None) -> list[dict]:
     """Parsed rows of the evidence ledger (malformed lines skipped).
 

@@ -5,7 +5,10 @@ The axon tunnel flaps; evidence only accumulates while a window is open
 up, captures in strict value order:
 
   1. a fresh headline bench (``python bench.py`` — evidence-tuned config,
-     appends a ``kind: bench`` row) unless one landed within the last hour
+     appends a ``kind: bench`` row) when stale: >1h since the last TPU
+     bench row, or a config-driving A/B row postdates it; re-checked
+     AFTER the sweep too, so a winner flipped mid-window re-anchors the
+     headline before the tunnel can close
   2. the full decision sweep (``scripts/tpu_opportunistic.py``: unmeasured
      sort variants -> engine sort-mode/block/table/pallas A/Bs + stage
      decomposition/profiler/parity -> Pallas check battery last) —
@@ -51,6 +54,7 @@ sys.path.insert(0, REPO)
 # touches a jax backend; probes/jobs run in killable subprocesses
 # instead.  test_farm_loop_import_is_jax_free pins the invariant.
 from locust_tpu.utils.artifacts import (  # noqa: E402
+    CONFIG_AB_KINDS as _artifacts_CONFIG_AB_KINDS,
     latest_row_ts as _latest_row_ts,
     ledger_rows as _ledger_rows,
 )
@@ -208,10 +212,25 @@ def next_ab_bytes() -> int:
     return 32 << 20
 
 
+def bench_stale() -> bool:
+    """Re-capture the headline when it is >1h old (doubles as a repeat
+    measurement — every TPU number in the repo should be second-sourced)
+    OR when a CONFIG-DRIVING A/B row postdates the last bench row:
+    bench.py derives its configuration from exactly the
+    ``CONFIG_AB_KINDS`` rows, so newer tuning inputs mean the committed
+    headline no longer reflects the adopted config."""
+    b = latest_ts("bench")
+    if time.time() - b > 3600:
+        return True
+    return any(
+        latest_ts(kind) > b for kind in _artifacts_CONFIG_AB_KINDS
+    )
+
+
 def harvest_window() -> None:
-    """One open window: bench -> sweep -> (stream) -> commit."""
-    # 1. Headline bench, unless a TPU bench row landed within the hour.
-    if time.time() - latest_ts("bench") > 3600:
+    """One open window: bench -> sweep -> re-anchor bench -> commit."""
+    # 1. Headline bench through the driver's own path, when stale.
+    if bench_stale():
         run([sys.executable, "bench.py"], timeout=1300)
         commit_ledger()
     # 2. Full decision sweep (hasht + bitonic verdicts, sort-mode/block/
@@ -232,6 +251,13 @@ def harvest_window() -> None:
     run([sys.executable, os.path.join("scripts", "tpu_opportunistic.py")],
         timeout=2400, env=env)
     commit_ledger()
+    # 3. Re-anchor the headline IN THIS WINDOW if the sweep's A/B rows
+    #    changed the tuning inputs: the flapping tunnel may never reopen
+    #    (CLAUDE.md), so "next window" is not a safe place to capture
+    #    the bench at a freshly-flipped config.
+    if bench_stale():
+        run([sys.executable, "bench.py"], timeout=1300)
+        commit_ledger()
 
 
 def main() -> int:

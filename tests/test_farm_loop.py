@@ -158,3 +158,28 @@ def test_farm_loop_reads_pinned_to_ledger(monkeypatch, tmp_path):
         {"kind": "bench", "backend": "tpu", "ts": 999.0}) + "\n")
     monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(other))
     assert m.latest_ts("bench") == 123.0  # pinned, not 999.0
+
+
+def test_bench_stale_on_newer_tuning_inputs(monkeypatch, tmp_path):
+    """A sweep that lands A/B rows after the last bench row must make
+    the bench stale immediately (the headline has to re-anchor at the
+    possibly-flipped config in the SAME window), while a fresh bench
+    row newer than all tuning inputs is not stale."""
+    m = _load(monkeypatch, tmp_path)
+    now = time.time()
+
+    def write(rows):
+        with open(m.LEDGER, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    bench_row = {"kind": "bench", "backend": "tpu", "ts": now - 600}
+    ab_old = {"kind": "engine_sort_mode_ab", "backend": "tpu",
+              "ts": now - 1200}
+    write([bench_row, ab_old])
+    assert m.bench_stale() is False  # recent bench, older tuning inputs
+    ab_new = {"kind": "block_lines_ab", "backend": "tpu", "ts": now - 30}
+    write([bench_row, ab_old, ab_new])
+    assert m.bench_stale() is True   # tuning input postdates the bench
+    write([{"kind": "bench", "backend": "tpu", "ts": now - 7200}])
+    assert m.bench_stale() is True   # the 1h repeat-measurement rule
