@@ -6,7 +6,11 @@ Run on hardware (the suite pins CPU):
 
 1. Compiles + executes the Pallas tokenizer kernel (interpret=False).
 2. A/B times the Pallas vs jnp Map stage at bench shapes.
-3. Prints one JSON line per check; artifact-friendly.
+3. Prints one JSON line per check it RUNS (artifact-friendly); checks
+   already answered this session (a usable row passing
+   opp_resume._session_row_ok) are skipped with a stderr note and print
+   nothing on stdout — the ledger row is the durable record, stdout is
+   progress reporting.
 """
 
 import functools
@@ -20,6 +24,62 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from locust_tpu.config import machine_cache_dir  # noqa: E402 - jax-free
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
+
+
+def _row_usable(name: str, r: dict) -> bool:
+    """Did this prior row actually ANSWER its check?  A row recording
+    only failures must not retire the check — the re-attempt is the
+    point (matches check 3's own errored-row re-attempt policy).  The
+    ladders require every rung measured (one transiently-errored tile
+    would otherwise be unmeasurable all session); the rescue counts as
+    answered once ANY rung produced a hardware ms."""
+    def rungs_ok(field, require_all):
+        v = r.get(field)
+        if not isinstance(v, dict) or not v:
+            return False
+        have = [isinstance(x, dict) and "ms" in x for x in v.values()]
+        return all(have) if require_all else any(have)
+
+    if name == "pallas_tokenizer_tpu":
+        return "matches_jnp" in r
+    if name == "map_ab":
+        return "pallas_ms" in r
+    if name == "bitonic_tile_ab":
+        return rungs_ok("tiles", require_all=True)
+    if name == "bitonic_fused_ab":
+        return rungs_ok("fused", require_all=True)
+    if name == "bitonic_rescue":
+        return rungs_ok("rungs", require_all=False)
+    return True
+
+
+def session_done_checks() -> dict:
+    """Session-valid USABLE battery rows by check name (newest wins) —
+    the per-check resume input (same validity rule as the sweep's phase
+    skips, opp_resume._session_row_ok, plus _row_usable): a battery
+    killed mid-run re-pays only the unanswered checks' compiles next
+    window; check 3's Mosaic compile alone is ~100s of a flapping
+    window."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import opp_resume
+
+    from locust_tpu.utils.artifacts import ledger_rows
+
+    done: dict = {}
+    for r in ledger_rows():
+        if (r.get("kind") == "tpu_check" and r.get("backend") == "tpu"
+                and r.get("check") and opp_resume._session_row_ok(r)
+                and _row_usable(r["check"], r)):
+            try:
+                newer = float(r.get("ts") or 0) >= float(
+                    done.get(r["check"], {}).get("ts") or 0
+                )
+            except (TypeError, ValueError):
+                continue
+            if newer:
+                done[r["check"]] = r
+    return done
+
 
 def main() -> int:
     from locust_tpu.backend import select_backend
@@ -44,27 +104,37 @@ def main() -> int:
     lines = (text * (cfg.block_lines // len(text) + 1))[: cfg.block_lines]
     rows = jnp.asarray(bytes_ops.strings_to_rows(lines, cfg.line_width))
 
-    # 1. Pallas kernel compiles + runs for real, and matches the jnp path.
-    jit_tokenize = jax.jit(tokenize_block, static_argnames=("cfg",))
-    t0 = time.perf_counter()
-    pk, pv, povf = tokenize_block_pallas(rows, cfg, interpret=False)
-    jax.block_until_ready(pk)
-    compile_s = time.perf_counter() - t0
-    ref = jit_tokenize(rows, cfg=cfg)
-    match = bool(
-        jnp.array_equal(pk, ref.keys)
-        and jnp.array_equal(pv, ref.valid)
-        and int(povf) == int(ref.overflow)
-    )
     from locust_tpu.utils import artifacts
 
-    row = {
-        "check": "pallas_tokenizer_tpu",
-        "compile_s": round(compile_s, 1),
-        "matches_jnp": match,
-    }
-    print(json.dumps(row), flush=True)
-    artifacts.record("tpu_check", row)
+    done_rows = session_done_checks()
+
+    def _skip(name: str) -> bool:
+        if name in done_rows:
+            print(f"[tpu_checks] {name}: already answered this session; "
+                  f"skipping", file=sys.stderr, flush=True)
+            return True
+        return False
+
+    # 1. Pallas kernel compiles + runs for real, and matches the jnp path.
+    jit_tokenize = jax.jit(tokenize_block, static_argnames=("cfg",))
+    if not _skip("pallas_tokenizer_tpu"):
+        t0 = time.perf_counter()
+        pk, pv, povf = tokenize_block_pallas(rows, cfg, interpret=False)
+        jax.block_until_ready(pk)
+        compile_s = time.perf_counter() - t0
+        ref = jit_tokenize(rows, cfg=cfg)
+        match = bool(
+            jnp.array_equal(pk, ref.keys)
+            and jnp.array_equal(pv, ref.valid)
+            and int(povf) == int(ref.overflow)
+        )
+        row = {
+            "check": "pallas_tokenizer_tpu",
+            "compile_s": round(compile_s, 1),
+            "matches_jnp": match,
+        }
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
 
     # 2. A/B: pallas vs jnp map stage steady-state.
     def best_ms(fn, reps=5):
@@ -76,72 +146,88 @@ def main() -> int:
             best = min(best, time.perf_counter() - t0)
         return best * 1e3
 
-    # Both sides jitted: the engine runs the jnp tokenizer under jit, so an
-    # eager jnp side would overstate the Pallas win.
-    jnp_ms = best_ms(lambda: jit_tokenize(rows, cfg=cfg).keys)
-    pal_ms = best_ms(
-        lambda: tokenize_block_pallas(rows, cfg, interpret=False)[0]
-    )
-    row = {
-        "check": "map_ab",
-        "block_lines": cfg.block_lines,
-        "line_width": cfg.line_width,
-        "jnp_ms": round(jnp_ms, 3),
-        "pallas_ms": round(pal_ms, 3),
-        "pallas_speedup": round(jnp_ms / pal_ms, 2),
-    }
-    print(json.dumps(row), flush=True)
-    artifacts.record("tpu_check", row)
+    if not _skip("map_ab"):
+        # Both sides jitted: the engine runs the jnp tokenizer under jit,
+        # so an eager jnp side would overstate the Pallas win.
+        jnp_ms = best_ms(lambda: jit_tokenize(rows, cfg=cfg).keys)
+        pal_ms = best_ms(
+            lambda: tokenize_block_pallas(rows, cfg, interpret=False)[0]
+        )
+        row = {
+            "check": "map_ab",
+            "block_lines": cfg.block_lines,
+            "line_width": cfg.line_width,
+            "jnp_ms": round(jnp_ms, 3),
+            "pallas_ms": round(pal_ms, 3),
+            "pallas_speedup": round(jnp_ms / pal_ms, 2),
+        }
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
 
     # 3. Pallas bitonic Process-stage sort: Mosaic compile + host-verified
     # correctness + A/B vs the best stock-sort mode at engine shape
     # (VERDICT r3 next #2).  Error-isolated: a Mosaic lowering failure
     # must leave checks 1-2's rows intact and still record the loss.
-    try:
-        import numpy as np
+    import numpy as np
 
-        from locust_tpu.ops.pallas.sort import bitonic_sort
+    n = 65536 + 32768 * 20  # table + emits: the fold's true sort shape
+    rng = np.random.default_rng(3)
+    # < 0xFFFFFFFF: the pad sentinel ties with real rows and may
+    # displace their payloads (bitonic_sort docstring caveat).
+    key = jnp.asarray(rng.integers(0, 2**32 - 1, n, dtype=np.uint32))
+    pay = jnp.asarray(np.arange(n, dtype=np.int32))
 
-        n = 65536 + 32768 * 20  # table + emits: the fold's true sort shape
-        rng = np.random.default_rng(3)
-        # < 0xFFFFFFFF: the pad sentinel ties with real rows and may
-        # displace their payloads (bitonic_sort docstring caveat).
-        key = jnp.asarray(rng.integers(0, 2**32 - 1, n, dtype=np.uint32))
-        pay = jnp.asarray(np.arange(n, dtype=np.int32))
+    prior3 = done_rows.get("bitonic_sort_ab")
+    if (prior3 and prior3.get("matches_oracle")
+            and prior3.get("n") == n and "bitonic_ms" in prior3):
+        # Reuse the session-valid VERIFIED measurement: the ladders below
+        # only need its oracle verdict and ms seed, and skipping here
+        # saves the kernel's ~100s Mosaic compile.  (An errored or
+        # unverified prior row does NOT skip — the re-attempt IS the
+        # point then.)
+        row = {k: prior3[k] for k in ("check", "n", "compile_s",
+                                      "matches_oracle", "bitonic_ms",
+                                      "lax_sort_ms", "bitonic_speedup")
+               if k in prior3}
+        print("[tpu_checks] bitonic_sort_ab: reusing session-valid "
+              "verified row; skipping compile", file=sys.stderr, flush=True)
+    else:
+        try:
+            from locust_tpu.ops.pallas.sort import bitonic_sort
 
-        sort_j = jax.jit(lambda k, p: bitonic_sort(k, (p,), interpret=False))
-        t0 = time.perf_counter()
-        sk, (sp,) = sort_j(key, pay)
-        jax.block_until_ready(sk)
-        compile_s = time.perf_counter() - t0
-        ok = bool(
-            np.array_equal(np.asarray(sk), np.sort(np.asarray(key)))
-            and np.array_equal(
-                np.asarray(key)[np.asarray(sp)], np.asarray(sk)
+            sort_j = jax.jit(
+                lambda k, p: bitonic_sort(k, (p,), interpret=False)
             )
-        )
+            t0 = time.perf_counter()
+            sk, (sp,) = sort_j(key, pay)
+            jax.block_until_ready(sk)
+            compile_s = time.perf_counter() - t0
+            ok = bool(
+                np.array_equal(np.asarray(sk), np.sort(np.asarray(key)))
+                and np.array_equal(
+                    np.asarray(key)[np.asarray(sp)], np.asarray(sk)
+                )
+            )
 
-        lax_j = jax.jit(lambda k, p: jax.lax.sort((k, p), num_keys=1))
-        bit_ms = best_ms(lambda: sort_j(key, pay)[0])
-        lax_ms = best_ms(lambda: lax_j(key, pay)[0])
-        row = {
-            "check": "bitonic_sort_ab",
-            "n": n,
-            "compile_s": round(compile_s, 1),
-            "matches_oracle": ok,
-            "bitonic_ms": round(bit_ms, 3),
-            "lax_sort_ms": round(lax_ms, 3),
-            "bitonic_speedup": round(lax_ms / bit_ms, 2),
-        }
-    except Exception as e:  # noqa: BLE001 - record the loss, keep the sweep
-        row = {
-            "check": "bitonic_sort_ab",
-            "error": f"{type(e).__name__}: {e}"[:400],
-        }
-    print(json.dumps(row), flush=True)
-    artifacts.record("tpu_check", row)
-
-    import numpy as np  # noqa: F811 - also imported in the try above
+            lax_j = jax.jit(lambda k, p: jax.lax.sort((k, p), num_keys=1))
+            bit_ms = best_ms(lambda: sort_j(key, pay)[0])
+            lax_ms = best_ms(lambda: lax_j(key, pay)[0])
+            row = {
+                "check": "bitonic_sort_ab",
+                "n": n,
+                "compile_s": round(compile_s, 1),
+                "matches_oracle": ok,
+                "bitonic_ms": round(bit_ms, 3),
+                "lax_sort_ms": round(lax_ms, 3),
+                "bitonic_speedup": round(lax_ms / bit_ms, 2),
+            }
+        except Exception as e:  # noqa: BLE001 - record the loss
+            row = {
+                "check": "bitonic_sort_ab",
+                "error": f"{type(e).__name__}: {e}"[:400],
+            }
+        print(json.dumps(row), flush=True)
+        artifacts.record("tpu_check", row)
 
     def make_rung(key_arr, pay_arr):
         """Oracle-verified bitonic timing rung over the GIVEN arrays:
@@ -192,36 +278,42 @@ def main() -> int:
         # 4. Tile sweep: where is the VMEM-residency/round-trip knee?
         # The default tile reuses check 3's verified measurement — a
         # flapping window should spend its seconds on the NEW points.
-        tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
-                                  "compile_s": 0.0,
-                                  "note": "from bitonic_sort_ab"}}
-        for tr in (128, 256, 512, 1024):
-            if tr == TILE_ROWS:
-                continue  # already measured (and verified) by check 3
-            tiles[str(tr)] = bitonic_rung(f"tile {tr}", tile_rows=tr)
-        row = {"check": "bitonic_tile_ab", "n": n, "tiles": tiles}
-        print(json.dumps(row), flush=True)
-        artifacts.record("tpu_check", row)
+        if not _skip("bitonic_tile_ab"):
+            tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
+                                      "compile_s": 0.0,
+                                      "note": "from bitonic_sort_ab"}}
+            for tr in (128, 256, 512, 1024):
+                if tr == TILE_ROWS:
+                    continue  # already measured (and verified) by check 3
+                tiles[str(tr)] = bitonic_rung(f"tile {tr}", tile_rows=tr)
+            row4 = {"check": "bitonic_tile_ab", "n": n, "tiles": tiles}
+            print(json.dumps(row4), flush=True)
+            artifacts.record("tpu_check", row4)
+        else:
+            tiles = done_rows["bitonic_tile_ab"].get("tiles") or {}
 
         # 5. Fusion-cap ladder: the static default is capped at
         # config.BITONIC_MAX_FUSED because UNLIMITED fusion crashed
         # Mosaic on 2026-07-31 — but that crash predates the int32-mask
         # rewrite, so this ladder measures whether the cap is still
         # needed and what it costs.
-        from locust_tpu.config import BITONIC_MAX_FUSED
+        if not _skip("bitonic_fused_ab"):
+            from locust_tpu.config import BITONIC_MAX_FUSED
 
-        fused = {str(BITONIC_MAX_FUSED): {
-            "ms": tiles.get(str(TILE_ROWS), {}).get("ms"),
-            "note": "config default, from bitonic_tile_ab",
-        }}
-        for mf in (128, 0):
-            if mf == BITONIC_MAX_FUSED:
-                continue
-            fused[str(mf)] = bitonic_rung(f"max_fused={mf}", max_fused=mf)
-        row = {"check": "bitonic_fused_ab", "n": n, "fused": fused}
-        print(json.dumps(row), flush=True)
-        artifacts.record("tpu_check", row)
-    elif "key" in locals():
+            fused = {str(BITONIC_MAX_FUSED): {
+                "ms": (tiles.get(str(TILE_ROWS), {}).get("ms")
+                       or row.get("bitonic_ms")),
+                "note": "config default, from bitonic_tile_ab",
+            }}
+            for mf in (128, 0):
+                if mf == BITONIC_MAX_FUSED:
+                    continue
+                fused[str(mf)] = bitonic_rung(f"max_fused={mf}",
+                                              max_fused=mf)
+            row5 = {"check": "bitonic_fused_ab", "n": n, "fused": fused}
+            print(json.dumps(row5), flush=True)
+            artifacts.record("tpu_check", row5)
+    elif not _skip("bitonic_rescue"):
         # Rescue bisect (VERDICT r4 next #3: "bisect kernel size until
         # something compiles and commit whatever ms results"): the
         # default configuration failed, so walk simpler schedules —

@@ -321,3 +321,71 @@ def test_prior_mode_results_no_carry_chaining(tmp_path, monkeypatch):
     )
     carried = m._prior_mode_results(33.6, caps)
     assert set(carried) == {"hashp2"}, carried
+
+
+def test_tpu_checks_session_done_checks(tmp_path, monkeypatch):
+    """Battery per-check resume input: session-valid USABLE rows keyed
+    by check name, newest ts wins; stale-code, pre-session, and
+    error-only rows excluded."""
+    import importlib.util
+    import json
+    import time
+
+    from locust_tpu.utils.artifacts import code_fingerprint
+
+    # Loading the script module mutates process state (sys.path insert,
+    # JAX_COMPILATION_CACHE_DIR setdefault — CLAUDE.md flags that cache
+    # dir as SIGILL-risky across hosts); sandbox both so nothing leaks
+    # into the rest of the suite.
+    monkeypatch.setattr(sys, "path", list(sys.path))
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cc")),
+    )
+    spec = importlib.util.spec_from_file_location(
+        "tpu_checks_under_test", os.path.join(REPO, "scripts",
+                                              "tpu_checks.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 600))
+    rows = [
+        {"ts": now - 100, "kind": "tpu_check", "backend": "tpu",
+         "check": "map_ab", "jnp_ms": 5.0, "pallas_ms": 2.0},
+        # Newer duplicate of the same check: wins.
+        {"ts": now - 10, "kind": "tpu_check", "backend": "tpu",
+         "check": "map_ab", "jnp_ms": 4.0, "pallas_ms": 1.9},
+        # Verified check-3 row at current code.
+        {"ts": now - 50, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_sort_ab", "matches_oracle": True,
+         "bitonic_ms": 64.0, "code": code_fingerprint()},
+        # Stale-code row: excluded.
+        {"ts": now - 5, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_tile_ab", "code": "0badc0de0000"},
+        # Pre-session unstamped row: excluded.
+        {"ts": now - 7200, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_fused_ab"},
+        # Session-valid but one tile rung ERRORED: not usable — the
+        # errored point must be re-measurable next window.
+        {"ts": now - 20, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_tile_ab",
+         "tiles": {"256": {"ms": 64.0}, "1024": {"error": "hiccup"}}},
+        # All-error rescue: not usable (no hardware ms yet).
+        {"ts": now - 20, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_rescue",
+         "rungs": {"mf=8": {"error": "mosaic"}}},
+    ]
+    (led / "tpu_runs.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    done = mod.session_done_checks()
+    assert set(done) == {"map_ab", "bitonic_sort_ab"}, done
+    assert done["map_ab"]["jnp_ms"] == 4.0  # newest wins
+    # A rescue with ANY measured rung IS usable.
+    assert mod._row_usable("bitonic_rescue",
+                           {"rungs": {"a": {"error": "x"},
+                                      "b": {"ms": 9.0}}})
