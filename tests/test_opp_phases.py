@@ -17,6 +17,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _load():
+    # tests/conftest.py pins JAX_COMPILATION_CACHE_DIR (machine-keyed
+    # "_cpu" dir) before any test runs, so the module's setdefault here
+    # is a no-op in the suite.
     sys.path.insert(0, REPO)  # opp_resume imports bench
     spec = importlib.util.spec_from_file_location(
         "opp_resume_under_test", os.path.join(REPO, "scripts", "opp_resume.py")
