@@ -261,10 +261,7 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
             out[k] = v
         return out
 
-    # Evidence must never break a run (same stance as utils/artifacts.py),
-    # and one malformed row must not revert knobs validly adopted from
-    # OTHER kinds (ADVICE r3): each kind is guarded independently; the
-    # outer except stays as a last-resort backstop.
+    # Evidence must never break a run (same stance as utils/artifacts.py).
     def newest_matching(rows, extra=None):
         """Newest row passing the joint-measurement rules — NOT just
         rows[-1]: the farm's second-sourcing sweeps (8MB/64MB) append
@@ -276,53 +273,45 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
                 return r
         return None
 
-    try:
-        try:
-            ab_row = newest_matching(_tpu_rows("engine_sort_mode_ab"))
-            if ab_row is not None:
-                modes = lossless_sides(ab_row.get("modes", {}))
-                best = max(modes, key=lambda m: side_mb(modes.get(m)), default=None)
-                if best is not None and side_mb(modes.get(best)) > 0.0:
-                    from locust_tpu.config import SORT_MODES
+    def adopt_sort_mode(kind: str) -> None:
+        ab_row = newest_matching(_tpu_rows(kind))
+        if ab_row is None:
+            return
+        modes = lossless_sides(ab_row.get("modes", {}))
+        best = max(modes, key=lambda m: side_mb(modes.get(m)), default=None)
+        if best is not None and side_mb(modes.get(best)) > 0.0:
+            from locust_tpu.config import SORT_MODES
 
-                    if best in SORT_MODES:
-                        out["sort_mode"] = best
-                        print(
-                            f"[bench] evidence-tuned sort_mode={best} "
-                            f"({modes[best].get('mb_s')} MB/s in the last TPU A/B)",
-                            file=sys.stderr,
-                        )
-        except Exception as e:  # noqa: BLE001 - skip this kind only
-            print(
-                f"[bench] sort-mode evidence skipped ({type(e).__name__}: {e})",
-                file=sys.stderr,
-            )
+            if best in SORT_MODES:
+                out["sort_mode"] = best
+                print(
+                    f"[bench] evidence-tuned sort_mode={best} "
+                    f"({modes[best].get('mb_s')} MB/s in the last TPU A/B)",
+                    file=sys.stderr,
+                )
+
+    def adopt_block_lines(kind: str) -> None:
         # Only adopt a block size measured AT the adopted sort mode — the
         # block_lines_ab row records which mode it swept with (older rows
         # predate the field and swept the historical default "hash"), so
         # the joint configuration is always one a window actually ran.
-        try:
-            row = newest_matching(
-                _tpu_rows("block_lines_ab"),
-                extra=lambda r: r.get("sort_mode", "hash") == out["sort_mode"],
-            )
-            if row is not None:
-                blocks = lossless_sides(row.get("blocks") or {})
-                best = max(
-                    blocks, key=lambda b: side_mb(blocks.get(b)), default=None
-                )
-                if best is not None and side_mb(blocks.get(best)) > 0.0:
-                    out["block_lines"] = int(best)
-                    print(
-                        f"[bench] evidence-tuned block_lines={best} "
-                        f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
-                        file=sys.stderr,
-                    )
-        except Exception as e:  # noqa: BLE001 - skip this kind only
+        row = newest_matching(
+            _tpu_rows(kind),
+            extra=lambda r: r.get("sort_mode", "hash") == out["sort_mode"],
+        )
+        if row is None:
+            return
+        blocks = lossless_sides(row.get("blocks") or {})
+        best = max(blocks, key=lambda b: side_mb(blocks.get(b)), default=None)
+        if best is not None and side_mb(blocks.get(best)) > 0.0:
+            out["block_lines"] = int(best)
             print(
-                f"[bench] block-lines evidence skipped ({type(e).__name__}: {e})",
+                f"[bench] evidence-tuned block_lines={best} "
+                f"({blocks[best].get('mb_s')} MB/s in the last TPU A/B)",
                 file=sys.stderr,
             )
+
+    def adopt_table_size(kind: str) -> None:
         # table_size: adopt only a size measured AT the adopted
         # (sort_mode, block_lines) — the distinct-aware accumulator
         # sizing (engine_table_ab rows; the fold re-aggregates every
@@ -330,63 +319,85 @@ def _evidence_tuned_tpu_defaults(defaults: dict, caps: dict | None = None) -> di
         # when the default is mostly padding).  Truncated sides record
         # truncated=True and are additionally dropped by lossless_sides'
         # distinct anchor.
-        try:
-            row = newest_matching(
-                _tpu_rows("engine_table_ab"),
-                extra=lambda r: (
-                    r.get("sort_mode", "hash") == out["sort_mode"]
-                    and int(r.get("block_lines", 32768)) == out["block_lines"]
-                ),
-            )
-            if row is not None:
-                tables = lossless_sides(row.get("tables") or {})
-                tables = {
-                    k: v for k, v in tables.items() if not v.get("truncated")
-                }
-                best = max(
-                    tables, key=lambda t: side_mb(tables.get(t)), default=None
-                )
-                if best is not None and side_mb(tables.get(best)) > 0.0:
-                    out["table_size"] = int(best)
-                    print(
-                        f"[bench] evidence-tuned table_size={best} "
-                        f"({tables[best].get('mb_s')} MB/s in the last TPU A/B)",
-                        file=sys.stderr,
-                    )
-        except Exception as e:  # noqa: BLE001 - skip this kind only
+        row = newest_matching(
+            _tpu_rows(kind),
+            extra=lambda r: (
+                r.get("sort_mode", "hash") == out["sort_mode"]
+                and int(r.get("block_lines", 32768)) == out["block_lines"]
+            ),
+        )
+        if row is None:
+            return
+        tables = lossless_sides(row.get("tables") or {})
+        tables = {k: v for k, v in tables.items() if not v.get("truncated")}
+        best = max(tables, key=lambda t: side_mb(tables.get(t)), default=None)
+        if best is not None and side_mb(tables.get(best)) > 0.0:
+            out["table_size"] = int(best)
             print(
-                f"[bench] table-size evidence skipped ({type(e).__name__}: {e})",
+                f"[bench] evidence-tuned table_size={best} "
+                f"({tables[best].get('mb_s')} MB/s in the last TPU A/B)",
                 file=sys.stderr,
             )
+
+    def adopt_use_pallas(kind: str) -> None:
         # use_pallas: adopt only a measured engine-level win, and only if
         # the row was swept AT the adopted (sort_mode, block_lines,
         # table_size) — same joint-measurement rule as above.  A side
         # that errored has no "mb_s" key and loses.
-        try:
-            row = newest_matching(
-                _tpu_rows("engine_pallas_ab"),
-                extra=lambda r: (
-                    r.get("sort_mode", "hash") == out["sort_mode"]
-                    and int(r.get("block_lines", 32768)) == out["block_lines"]
-                    and r.get("table_size") == out.get("table_size")
-                ),
-            )
-            if row is not None:
-                sides = lossless_sides(row.get("pallas") or {})
-                on = side_mb(sides.get("True"))
-                off = side_mb(sides.get("False"))
-                if on > off > 0.0:
-                    out["use_pallas"] = True
-                    print(
-                        f"[bench] evidence-tuned use_pallas=True "
-                        f"({on} vs {off} MB/s in the last TPU A/B)",
-                        file=sys.stderr,
-                    )
-        except Exception as e:  # noqa: BLE001 - skip this kind only
+        row = newest_matching(
+            _tpu_rows(kind),
+            extra=lambda r: (
+                r.get("sort_mode", "hash") == out["sort_mode"]
+                and int(r.get("block_lines", 32768)) == out["block_lines"]
+                and r.get("table_size") == out.get("table_size")
+            ),
+        )
+        if row is None:
+            return
+        sides = lossless_sides(row.get("pallas") or {})
+        on = side_mb(sides.get("True"))
+        off = side_mb(sides.get("False"))
+        if on > off > 0.0:
+            out["use_pallas"] = True
             print(
-                f"[bench] pallas evidence skipped ({type(e).__name__}: {e})",
+                f"[bench] evidence-tuned use_pallas=True "
+                f"({on} vs {off} MB/s in the last TPU A/B)",
                 file=sys.stderr,
             )
+
+    # Per-kind readers, ITERATED off the shared artifacts.CONFIG_AB_KINDS
+    # tuple (ADVICE r5): the anti-drift guarantee is now two-sided — a
+    # kind added to the tuple without a reader here, or a reader added
+    # without extending the tuple, fails this identity check loudly
+    # (order included: later kinds adopt jointly with earlier winners)
+    # instead of leaving the committed headline silently stale.
+    adopters = {
+        "engine_sort_mode_ab": adopt_sort_mode,
+        "block_lines_ab": adopt_block_lines,
+        "engine_table_ab": adopt_table_size,
+        "engine_pallas_ab": adopt_use_pallas,
+    }
+    from locust_tpu.utils.artifacts import CONFIG_AB_KINDS
+
+    if tuple(adopters) != tuple(CONFIG_AB_KINDS):
+        raise RuntimeError(
+            "bench evidence readers drifted from artifacts.CONFIG_AB_KINDS: "
+            f"{tuple(adopters)} != {tuple(CONFIG_AB_KINDS)}"
+        )
+
+    try:
+        for kind in CONFIG_AB_KINDS:
+            # One malformed row must not revert knobs validly adopted
+            # from OTHER kinds (ADVICE r3): each kind is guarded
+            # independently; the outer except stays as a backstop.
+            try:
+                adopters[kind](kind)
+            except Exception as e:  # noqa: BLE001 - skip this kind only
+                print(
+                    f"[bench] {kind} evidence skipped "
+                    f"({type(e).__name__}: {e})",
+                    file=sys.stderr,
+                )
     except Exception as e:  # noqa: BLE001 - tuning is best-effort
         print(
             f"[bench] evidence tuning skipped ({type(e).__name__}: {e}); "

@@ -215,7 +215,7 @@ class DistributedInvertedIndex:
     ):
         from jax.sharding import PartitionSpec as P
 
-        from locust_tpu.parallel.mesh import DATA_AXIS
+        from locust_tpu.parallel.mesh import DATA_AXIS, compat_shard_map
         from locust_tpu.parallel.shuffle import partition_to_bins, sized_bins
 
         axis = axis_name or DATA_AXIS
@@ -322,7 +322,7 @@ class DistributedInvertedIndex:
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
         self._step = jax.jit(
-            jax.shard_map(
+            compat_shard_map(
                 local_step,
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), kv_spec, kv_spec),

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from locust_tpu.parallel.mesh import DATA_AXIS
+from locust_tpu.parallel.mesh import DATA_AXIS, compat_shard_map
 
 
 def _contributions(src, dst, ranks, inv_deg, num_nodes):
@@ -95,7 +95,7 @@ class DistributedPageRank:
             return ranks_new
 
         self._step = jax.jit(
-            jax.shard_map(
+            compat_shard_map(
                 step,
                 mesh=mesh,
                 in_specs=(P(axis_name), P(axis_name), P(axis_name), P(), P(), P()),
@@ -283,7 +283,7 @@ class ShardedPageRank:
 
         spec = P(axis)
         step_j = jax.jit(
-            jax.shard_map(
+            compat_shard_map(
                 step,
                 mesh=self.mesh,
                 in_specs=(spec,) * 8,

@@ -113,6 +113,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", action="store_true",
                    help="print a wall-clock span report (load/run/output) "
                         "on stderr in addition to the stage report")
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos-test fault injection plan: JSON text or a "
+                        "path to a JSON file (also $LOCUST_FAULT_PLAN); "
+                        "zero overhead when unset — see docs/FAULTS.md")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax/XLA profiler trace of the run into "
                         "this directory (view with TensorBoard/XProf)")
@@ -139,6 +143,14 @@ def main(argv=None) -> int:
 
 
 def _run(args) -> int:
+
+    # Fault injection first: the plan must be live before any distributor
+    # RPC or checkpoint write it is meant to intercept (docs/FAULTS.md).
+    # Pure host-side control-plane hooks; a run with no plan pays one
+    # None-check per hook and nothing else.
+    from locust_tpu.utils import faultplan
+
+    faultplan.install(args.fault_plan)
 
     # Pod launch: join the coordination service BEFORE any in-process jax
     # backend init (jax.distributed.initialize is a no-op too late once

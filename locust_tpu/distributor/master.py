@@ -11,18 +11,35 @@ MapReduce program for all nodes" over a cluster file of ``ip port`` lines
   3. fan the staged map out to all workers in parallel,
   4. collect each node's intermediate TSV over the authenticated channel
      (the transport step missing from the reference, SURVEY.md §3.2) —
-     streamed in bounded offset-addressed chunks, so intermediates larger
-     than one protocol frame round-trip fine,
+     streamed in bounded offset-addressed chunks, sha256-verified per
+     chunk AND end-to-end against the digest the worker recorded at map
+     time, so intermediates larger than one protocol frame round-trip
+     fine and a corrupted chunk can never silently reach the reduce,
   5. run the reduce stage locally over all collected TSVs — which re-sorts,
      fixing the reference's unsorted-reduce-input bug (Q6).
 
 Fault tolerance (VERDICT r2 missing #6 — the reference has none, its slave
-ACKs unconditionally, slave.py:19-20): a shard whose worker fails (dead
-connection, timeout, non-zero map exit) is REASSIGNED to the next live
-worker, bounded by ``max_retries``; a worker that failed is quarantined
-for the rest of the job.  Line-range shards are deterministic and
-idempotent (same [start, end) slice on any node produces the same TSV), so
-re-running a shard elsewhere is always safe.
+ACKs unconditionally, slave.py:19-20), per Dean & Ghemawat's OSDI'04
+robustness recipe (re-execution + backup tasks + checksummed data):
+
+  * a shard whose worker fails (dead connection, timeout, non-zero map
+    exit, integrity mismatch) is REASSIGNED to the next live worker,
+    bounded by ``max_retries`` failed attempts per shard;
+  * a failed worker is QUARANTINED with exponential backoff + jitter
+    (``WorkerHealth``) instead of for the rest of the job: a heartbeat
+    loop pings quarantined workers once their backoff expires and
+    un-quarantines them on recovery, so a transient flap doesn't burn a
+    node for good;
+  * a shard still running past ``speculate_after`` seconds gets a
+    SPECULATIVE backup attempt on a different worker (the classic
+    MapReduce straggler mitigation) — first finisher wins, the loser is
+    abandoned (line-range shards are deterministic and idempotent, and
+    every attempt writes an attempt-unique intermediate path, so the
+    loser can never clobber the winner);
+  * per-shard attempt timings land in the returned ``JobResult.shards``.
+
+Chaos coverage: every failure path above is exercised under injected
+faults by tests/test_faults.py (locust_tpu/utils/faultplan.py).
 """
 
 from __future__ import annotations
@@ -30,16 +47,20 @@ from __future__ import annotations
 import argparse
 import base64
 import concurrent.futures
+import hashlib
 import logging
 import os
+import queue
 import socket
 import sys
 import tempfile
 import threading
+import time
 import uuid
 
 from locust_tpu.distributor import protocol
 from locust_tpu.io.loader import count_lines
+from locust_tpu.utils import faultplan
 
 logger = logging.getLogger("locust_tpu")
 
@@ -48,10 +69,144 @@ class MasterError(RuntimeError):
     pass
 
 
+class IntegrityError(MasterError):
+    """A fetched intermediate failed sha256 verification."""
+
+
 def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.0) -> dict:
+    faultplan.check_connect(node[0], node[1])
     with socket.create_connection(node, timeout=timeout) as sock:
         protocol.send_frame(sock, req, secret)
         return protocol.recv_frame(sock, secret)
+
+
+class WorkerHealth:
+    """Per-worker liveness with exponential backoff + deterministic jitter.
+
+    A failure quarantines the worker for ``base_s * 2**(consecutive-1)``
+    seconds (capped at ``cap_s``), stretched by up to ``jitter`` fraction
+    of deterministic (seeded) noise so a fleet of masters doesn't re-probe
+    a recovering worker in lockstep.  ``ok()`` clears the slate — the
+    un-quarantine-on-recovery half of the contract.  Injectable ``clock``
+    keeps the unit tests fake-clock deterministic (tests/test_faults.py).
+    Thread-safe: the shard tasks and the heartbeat loop mutate it
+    concurrently.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        clock=time.monotonic,
+        base_s: float = 0.5,
+        cap_s: float = 30.0,
+        jitter: float = 0.5,
+        seed: int = 0,
+    ):
+        self.n = n
+        self.clock = clock
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter = jitter
+        self.seed = seed
+        self._failures = [0] * n
+        self._until = [0.0] * n
+        self._lock = threading.Lock()
+
+    def fail(self, idx: int) -> float:
+        """Record a failure; returns the backoff applied (seconds)."""
+        with self._lock:
+            self._failures[idx] += 1
+            f = self._failures[idx]
+            back = min(self.cap_s, self.base_s * (2 ** (f - 1)))
+            back *= 1.0 + self.jitter * self._unit(idx, f)
+            self._until[idx] = self.clock() + back
+            return back
+
+    def ok(self, idx: int) -> None:
+        with self._lock:
+            self._failures[idx] = 0
+            self._until[idx] = 0.0
+
+    def healthy(self, idx: int) -> bool:
+        """Never-failed-recently: not quarantined at all."""
+        with self._lock:
+            return self._failures[idx] == 0
+
+    def probe_due(self, idx: int) -> bool:
+        """Quarantined AND its backoff has expired: eligible for a
+        heartbeat probe (or a direct work attempt, which doubles as one)."""
+        with self._lock:
+            return self._failures[idx] > 0 and self.clock() >= self._until[idx]
+
+    def quarantined(self, idx: int) -> bool:
+        with self._lock:
+            return self._failures[idx] > 0 and self.clock() < self._until[idx]
+
+    def failures(self, idx: int) -> int:
+        with self._lock:
+            return self._failures[idx]
+
+    def _unit(self, idx: int, f: int) -> float:
+        """Deterministic jitter in [0, 1): seeded, not wall-clock."""
+        h = hashlib.sha256(f"{self.seed}:{idx}:{f}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+
+class ShardStats:
+    """Timing/attempt record for one shard (JobResult.shards)."""
+
+    def __init__(self, shard: int):
+        self.shard = shard
+        self.attempts: list[dict] = []  # worker, speculative, t0, t1, outcome
+        self.winner: int | None = None  # worker index that produced the TSV
+        self.speculated = False
+        self.elapsed_s: float | None = None
+
+    def as_dict(self) -> dict:
+        return {
+            "shard": self.shard,
+            "winner": self.winner,
+            "speculated": self.speculated,
+            "elapsed_s": self.elapsed_s,
+            "attempts": list(self.attempts),
+        }
+
+
+class JobResult(list):
+    """The collected local TSV paths (list API unchanged for callers that
+    only reduce), plus per-shard timing stats and the final health view."""
+
+    def __init__(self, paths, shards: list[ShardStats], health: WorkerHealth):
+        super().__init__(paths)
+        self.shards = shards
+        self.health = health
+
+
+def _heartbeat_loop(
+    stop: threading.Event,
+    health: WorkerHealth,
+    cluster: list[tuple[str, int]],
+    rpc,
+    secret: bytes,
+    interval: float,
+) -> None:
+    """Ping quarantined workers whose backoff expired; un-quarantine on a
+    good pong, deepen the backoff otherwise.  Runs until the job ends."""
+    while not stop.wait(interval):
+        for idx in range(len(cluster)):
+            if stop.is_set():
+                return
+            if not health.probe_due(idx):
+                continue
+            try:
+                resp = rpc(cluster[idx], {"cmd": "ping"}, secret)
+                if resp.get("pong"):
+                    health.ok(idx)
+                    logger.info("worker %d recovered; un-quarantined", idx)
+                else:
+                    health.fail(idx)
+            except (OSError, MasterError, ValueError, PermissionError):
+                health.fail(idx)
 
 
 def run_job(
@@ -60,13 +215,26 @@ def run_job(
     secret: bytes,
     workdir: str | None = None,
     extra_args: list[str] | None = None,
-    rpc=_rpc,
+    rpc=None,
     max_retries: int = 2,
-) -> list[str]:
-    """Fan out map stages, collect TSVs; returns local TSV paths for reduce.
+    rpc_timeout: float = 1800.0,
+    heartbeat_interval: float = 2.0,
+    ping_timeout: float = 10.0,
+    speculate_after: float | None = None,
+    health: WorkerHealth | None = None,
+    poll_s: float = 0.05,
+) -> JobResult:
+    """Fan out map stages, collect + verify TSVs; returns a ``JobResult``
+    (a list of local TSV paths for the reduce, plus ``.shards`` stats).
 
-    Each of the ``len(cluster)`` line-range shards is tried on up to
-    ``max_retries + 1`` distinct live workers before the job fails.
+    Each of the ``len(cluster)`` line-range shards tolerates up to
+    ``max_retries`` FAILED attempts (each on a distinct worker) before the
+    job fails with ``MasterError``.  ``speculate_after`` seconds after a
+    shard's latest attempt started with no finisher, one speculative
+    backup attempt launches on a different worker — first success wins
+    (None disables speculation).  All waits are bounded: RPCs by
+    ``rpc_timeout`` and the scheduler poll by ``poll_s``, so a straggling
+    or injected-faulty worker can delay but never hang the job.
     """
     n = len(cluster)
     total = count_lines(input_file)
@@ -76,11 +244,24 @@ def run_job(
     # Unique per-job intermediate names: concurrent jobs against the same
     # worker pool must not clobber each other's TSVs.
     job_id = uuid.uuid4().hex[:12]
-    dead: set[int] = set()
-    dead_lock = threading.Lock()
+    health = health or WorkerHealth(n)
+    if rpc is None:
+        def rpc(node, req, s, _to=rpc_timeout):  # noqa: E306
+            return _rpc(node, req, s, timeout=_to)
 
-    def fetch_chunked(node, remote: str, local: str) -> None:
+        # Heartbeat pings are LIVENESS checks: a worker that accepts TCP
+        # but never replies (the wedged-tunnel mode, CLAUDE.md) must cost
+        # the serial probe loop seconds, not the map-stage timeout —
+        # otherwise one hung ping disables recovery probing for the rest
+        # of the job (code review, this PR).
+        def ping_rpc(node, req, s, _to=ping_timeout):
+            return _rpc(node, req, s, timeout=_to)
+    else:
+        ping_rpc = rpc
+
+    def fetch_chunked(node, remote: str, local: str, expect_sha: str | None) -> None:
         offset = 0
+        whole = hashlib.sha256()
         with open(local, "wb") as f:
             while True:
                 got = rpc(
@@ -93,15 +274,35 @@ def run_job(
                         f"fetch failed on node {node}: {got.get('error')}"
                     )
                 data = base64.b64decode(got["data_b64"])
+                # Per-chunk digest: catches corruption between the worker's
+                # disk read and this process (the HMAC covers the frame,
+                # but not a worker-side read or encode gone wrong).
+                chunk_sha = got.get("sha256")
+                if chunk_sha is not None and chunk_sha != hashlib.sha256(data).hexdigest():
+                    raise IntegrityError(
+                        f"fetch chunk at offset {offset} from {node} failed "
+                        "sha256 verification"
+                    )
                 f.write(data)
+                whole.update(data)
                 offset += len(data)
                 if got.get("eof", True) or not data:
-                    return
+                    break
+        # End-to-end digest: the worker hashed the TSV at map time, so any
+        # corruption after the map — disk rot, a truncated read, a lying
+        # chunk stream — surfaces here instead of as wrong counts.
+        if expect_sha is not None and whole.hexdigest() != expect_sha:
+            raise IntegrityError(
+                f"intermediate {remote} from {node} failed end-to-end sha256 "
+                "verification (corrupted after map)"
+            )
 
-    def try_shard(shard: int, node_idx: int) -> str:
+    def try_shard(shard: int, node_idx: int, attempt: int) -> str:
         node = cluster[node_idx]
         start, end = shard * per, min((shard + 1) * per, total)
-        inter = f"/tmp/locust_{job_id}_node{shard}.tsv"
+        # Attempt-unique remote/local paths: a speculative loser must not
+        # clobber the winner's file (loopback runs share one /tmp).
+        inter = f"/tmp/locust_{job_id}_shard{shard}_a{attempt}.tsv"
         resp = rpc(
             node,
             {
@@ -120,46 +321,167 @@ def run_job(
                 f"map failed on node {node}: rc={resp.get('returncode')} "
                 f"err={resp.get('error', '')}\n{resp.get('log', '')}"
             )
-        local = os.path.join(workdir, f"node{shard}.tsv")
-        fetch_chunked(node, inter, local)
+        local = os.path.join(workdir, f"node{shard}.a{attempt}.tsv")
+        fetch_chunked(node, inter, local, resp.get("sha256"))
         return local
 
-    def one(shard: int) -> str:
-        last_err: Exception | None = None
+    def pick_node(shard: int, tried: set[int], busy: set[int]) -> int | None:
+        """Next worker for this shard: home node first, then rotation;
+        healthy workers before quarantine-expired ones (a work attempt on
+        an expired-quarantine worker doubles as its heartbeat probe);
+        never one still inside its backoff window or already running an
+        attempt for this shard.  Once EVERY worker has been tried, a
+        recovered (or probe-eligible) one may be re-tried: two transient
+        flaps must not exhaust a two-worker pool while retry budget
+        remains — total attempts stay bounded by ``max_retries``."""
+        order = [(shard + k) % n for k in range(n)]
+        for idx in order:
+            if idx not in tried and idx not in busy and health.healthy(idx):
+                return idx
+        for idx in order:
+            if idx not in tried and idx not in busy and health.probe_due(idx):
+                return idx
+        if all(i in tried for i in order):
+            for idx in order:
+                if idx not in busy and (
+                    health.healthy(idx) or health.probe_due(idx)
+                ):
+                    return idx
+        return None
+
+    def one(shard: int) -> tuple[str, ShardStats]:
+        stats = ShardStats(shard)
+        shard_t0 = time.perf_counter()
+        done_q: queue.Queue = queue.Queue()
         tried: set[int] = set()
-        for _ in range(max_retries + 1):
-            with dead_lock:
-                # Prefer the shard's home node, then rotate; skip workers
-                # already dead or already tried for this shard.
-                alive = [
-                    (shard + k) % n
-                    for k in range(n)
-                    if (shard + k) % n not in dead
-                    and (shard + k) % n not in tried
-                ]
-            if not alive:
-                break
-            node_idx = alive[0]
+        pending: dict[int, dict] = {}  # attempt id -> {"worker", "t0", ...}
+        seq = 0
+        failed_attempts = 0
+        last_err: Exception | None = None
+        last_launch = time.perf_counter()
+        speculation_spent = False
+
+        def launch(speculative: bool) -> bool:
+            nonlocal seq, last_launch
+            busy = {r["worker"] for r in pending.values()}
+            node_idx = pick_node(shard, tried, busy)
+            if node_idx is None:
+                return False
             tried.add(node_idx)
-            try:
-                return try_shard(shard, node_idx)
-            except (MasterError, OSError) as e:
-                last_err = e
-                with dead_lock:
-                    dead.add(node_idx)
-                logger.warning(
-                    "shard %d failed on worker %d (%s); reassigning",
-                    shard,
-                    node_idx,
-                    e,
+            aid = seq
+            seq += 1
+            rec = {
+                "worker": node_idx,
+                "speculative": speculative,
+                "t0": time.perf_counter() - shard_t0,
+                "t1": None,
+                "outcome": "running",
+            }
+            stats.attempts.append(rec)
+            last_launch = time.perf_counter()
+
+            def attempt() -> None:
+                try:
+                    done_q.put((aid, node_idx, rec, try_shard(shard, node_idx, aid), None))
+                except (MasterError, OSError, ValueError) as e:
+                    done_q.put((aid, node_idx, rec, None, e))
+
+            threading.Thread(target=attempt, daemon=True).start()
+            pending[aid] = rec
+            if speculative:
+                stats.speculated = True
+                logger.info(
+                    "shard %d straggling; speculative backup on worker %d",
+                    shard, node_idx,
                 )
+            return True
+
+        def launch_or_wait() -> bool:
+            """Launch a retry, WAITING (bounded by the backoff cap) for a
+            quarantined worker to become probe-eligible: a cluster-wide
+            transient flap — every worker backing off at once — must cost
+            seconds of patience, not the whole job.  Returns False only
+            when the bounded wait expired with no launchable worker."""
+            deadline = time.perf_counter() + health.cap_s + 1.0
+            while time.perf_counter() < deadline:
+                if launch(speculative=False):
+                    return True
+                time.sleep(poll_s)
+            return False
+
+        if not launch_or_wait():
+            raise MasterError(
+                f"shard {shard} failed on every tried worker "
+                f"(max_retries={max_retries}): no live worker to start on"
+            )
+        while True:
+            try:
+                aid, node_idx, rec, local, err = done_q.get(timeout=poll_s)
+            except queue.Empty:
+                if (
+                    speculate_after is not None
+                    and not speculation_spent
+                    and pending
+                    and time.perf_counter() - last_launch >= speculate_after
+                ):
+                    # One backup per shard: Dean & Ghemawat's backup tasks,
+                    # not an unbounded fork-bomb.  A failed pick (no spare
+                    # worker) also spends the budget — re-polling an empty
+                    # pool every tick buys nothing.
+                    speculation_spent = True
+                    launch(speculative=True)
+                continue
+            rec["t1"] = time.perf_counter() - shard_t0
+            if err is None:
+                rec["outcome"] = "ok"
+                health.ok(node_idx)
+                for other in pending.values():
+                    if other is not rec and other["outcome"] == "running":
+                        other["outcome"] = "cancelled"  # abandoned loser
+                stats.winner = node_idx
+                stats.elapsed_s = time.perf_counter() - shard_t0
+                return local, stats
+            pending.pop(aid, None)
+            rec["outcome"] = (
+                "integrity" if isinstance(err, IntegrityError) else "error"
+            )
+            last_err = err
+            failed_attempts += 1
+            back = health.fail(node_idx)
+            logger.warning(
+                "shard %d attempt on worker %d failed (%s); worker backed "
+                "off %.2fs", shard, node_idx, err, back,
+            )
+            if failed_attempts > max_retries and not pending:
+                break
+            if not pending and not launch_or_wait():
+                break
         raise MasterError(
             f"shard {shard} failed on every tried worker "
             f"(max_retries={max_retries}): {last_err}"
         )
 
-    with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
-        return list(ex.map(one, range(n)))
+    stop = threading.Event()
+    hb = threading.Thread(
+        target=_heartbeat_loop,
+        args=(stop, health, cluster, ping_rpc, secret, heartbeat_interval),
+        daemon=True,
+    )
+    hb.start()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
+            results = list(ex.map(one, range(n)))
+    finally:
+        stop.set()
+    paths = [p for p, _ in results]
+    shards = [s for _, s in results]
+    for s in shards:
+        logger.info(
+            "shard %d: %.3fs on worker %s (%d attempt(s)%s)",
+            s.shard, s.elapsed_s or -1.0, s.winner, len(s.attempts),
+            ", speculated" if s.speculated else "",
+        )
+    return JobResult(paths, shards, health)
 
 
 def main(argv=None) -> int:
@@ -168,7 +490,15 @@ def main(argv=None) -> int:
     p.add_argument("input_file")
     p.add_argument("--secret-env", default="LOCUST_SECRET")
     p.add_argument("--workdir", default=None)
+    p.add_argument("--max-retries", type=int, default=2)
+    p.add_argument("--speculate-after", type=float, default=None,
+                   help="seconds before a straggling shard gets a "
+                        "speculative backup attempt (default: disabled)")
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos-test fault plan: JSON text or a path "
+                        f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
     args, passthrough = p.parse_known_args(argv)
+    faultplan.install(args.fault_plan)
     secret = os.environ.get(args.secret_env, "").encode()
     if not secret:
         print(f"error: set ${args.secret_env}", file=sys.stderr)
@@ -176,7 +506,16 @@ def main(argv=None) -> int:
     cluster = protocol.parse_cluster_file(args.cluster_file)
     print(f"[master] {len(cluster)} worker(s)", file=sys.stderr)
     tsvs = run_job(cluster, args.input_file, secret,
-                   workdir=args.workdir, extra_args=passthrough)
+                   workdir=args.workdir, extra_args=passthrough,
+                   max_retries=args.max_retries,
+                   speculate_after=args.speculate_after)
+    for s in tsvs.shards:
+        print(
+            f"[master] shard {s.shard}: {s.elapsed_s:.3f}s on worker "
+            f"{s.winner}, {len(s.attempts)} attempt(s)"
+            + (", speculated" if s.speculated else ""),
+            file=sys.stderr,
+        )
 
     # Local reduce over all collected TSVs (stage 2; re-sorts — Q6 fix).
     from locust_tpu import cli

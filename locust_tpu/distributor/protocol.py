@@ -29,6 +29,8 @@ import socket
 import struct
 import time
 
+from locust_tpu.utils import faultplan
+
 MAX_FRAME = 64 * 1024 * 1024  # hard frame bound; fetch stays far below it
 
 # fetch window sizing: intermediates larger than one frame stream in
@@ -66,7 +68,16 @@ def send_frame(
             f"frame of {len(frame)} bytes exceeds MAX_FRAME={MAX_FRAME}; "
             "chunk the transfer"
         )
-    sock.sendall(struct.pack("!I", len(frame)) + frame)
+    wire = struct.pack("!I", len(frame)) + frame
+    # Chaos: wire corruption/truncation (no-op without an active plan).
+    # The 4-byte length header is preserved — a corrupted frame BODY is
+    # caught by the HMAC (rejected, connection dropped) and a truncated
+    # one by the receiver's bounded read timeout; both are the failure
+    # modes the retry path must absorb (tests/test_faults.py).
+    wire = faultplan.mangle(
+        "rpc.frame", wire, keep_prefix=4, cmd=obj.get("cmd")
+    )
+    sock.sendall(wire)
 
 
 def recv_frame(sock: socket.socket, secret: bytes) -> dict:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import hashlib
 import os
 import socket
 import subprocess
@@ -24,6 +25,15 @@ import sys
 import threading
 
 from locust_tpu.distributor import protocol
+from locust_tpu.utils import faultplan
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def default_map_runner(req: dict) -> dict:
@@ -127,6 +137,8 @@ class Worker:
                 resp = self._handle(req)
             except PermissionError:
                 return  # unauthenticated/replayed peer: drop silently
+            except faultplan.FaultCrash:
+                return  # injected 'process crash': drop the conn, no reply
             except Exception as e:
                 # A malformed frame must never kill the daemon (that
                 # would be an unauthenticated remote DoS).
@@ -145,17 +157,51 @@ class Worker:
         cmd = req.get("cmd")
         if cmd not in protocol.COMMANDS:
             return {"status": "error", "error": f"unknown command {cmd!r}"}
+        # Chaos: straggler model — the worker stalls before handling
+        # (tests/test_faults.py; no-op without an active plan).
+        faultplan.delay(
+            "rpc.delay",
+            cmd=cmd, shard=req.get("node_num"), port=self.addr[1],
+        )
         if cmd == "ping":
             return {"status": "ok", "pong": True}
         if cmd == "shutdown":
             self._shutdown.set()
             return {"status": "ok", "bye": True}
         if cmd == "map":
+            rule = faultplan.fire(
+                "worker.map", shard=req.get("node_num"), port=self.addr[1]
+            )
+            if rule is not None:
+                if rule.action == "crash":
+                    raise faultplan.FaultCrash("injected crash mid-map")
+                if rule.action == "error":
+                    return {"status": "error", "returncode": -9,
+                            "log": "[faultplan] injected map failure",
+                            "error": "injected map failure"}
+                if rule.action == "delay":
+                    import time as _time
+
+                    _time.sleep(rule.delay_s)
             try:
                 with self._map_lock:  # one accelerator: maps serialize
-                    return self.map_runner(req)
+                    resp = self.map_runner(req)
             except Exception as e:  # propagate failure, don't fake-ACK
                 return {"status": "error", "error": repr(e)}
+            if resp.get("status") == "ok" and "sha256" not in resp:
+                # End-to-end integrity anchor: hash the intermediate at
+                # map time so the master can verify the assembled fetch
+                # against what the map actually wrote (Dean & Ghemawat's
+                # checksummed intermediates).  A runner that wrote no
+                # file (injected test runners) just ships no digest —
+                # the master skips the end-to-end check then, and a
+                # truly missing intermediate still fails at fetch time.
+                inter = resp.get("intermediate") or req.get("intermediate")
+                try:
+                    resp["sha256"] = _file_sha256(inter)
+                except (OSError, TypeError):
+                    pass
+            return resp
         # fetch: stream back an intermediate file this worker produced, one
         # bounded window per request so arbitrarily large TSVs fit the
         # frame limit (the master loops on ``offset`` until ``eof``).
@@ -179,12 +225,24 @@ class Worker:
                 data = f.read(max_bytes)
         except OSError as e:
             return {"status": "error", "error": str(e)}
+        # eof/total reflect the REAL read (pre-fault): an injected disk-rot
+        # corruption/truncation must look like a worker that believes it
+        # delivered the bytes — the master's sha256 verification is what
+        # catches it, not the fault being polite about itself.
+        eof = offset + len(data) >= size
+        data = faultplan.mangle(
+            "io.intermediate", data,
+            path=real, offset=offset, port=self.addr[1],
+        )
         return {
             "status": "ok",
             "data_b64": base64.b64encode(data).decode(),
+            # Per-chunk digest: covers the b64 round-trip and anything
+            # between this read and the master's disk write.
+            "sha256": hashlib.sha256(data).hexdigest(),
             "offset": offset,
             "total": size,
-            "eof": offset + len(data) >= size,
+            "eof": eof,
         }
 
 
@@ -194,7 +252,11 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=1337)  # reference port, slave.py:7
     p.add_argument("--secret-env", default="LOCUST_SECRET",
                    help="env var holding the shared secret")
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos-test fault plan: JSON text or a path "
+                        f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
     args = p.parse_args(argv)
+    faultplan.install(args.fault_plan)
     secret = os.environ.get(args.secret_env, "").encode()
     if not secret:
         print(f"error: set ${args.secret_env} (refusing unauthenticated mode)",

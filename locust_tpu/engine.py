@@ -399,27 +399,40 @@ class MapReduceEngine:
         overflow = jnp.int32(0)
         max_distinct = jnp.int32(0)
         if os.path.exists(state_path):
-            with np.load(state_path) as z:
-                if str(z["fingerprint"]) == fingerprint:
-                    start_block = int(z["next_block"])
-                    overflow = jnp.int32(int(z["overflow"]))
-                    max_distinct = jnp.int32(int(z["max_distinct"]))
-                    acc = KVBatch(
-                        key_lanes=jnp.asarray(z["key_lanes"]),
-                        values=jnp.asarray(z["values"]),
-                        valid=jnp.asarray(z["valid"]),
-                    )
-                    logger.info(
-                        "resuming from checkpoint at block %d (%s)",
-                        start_block,
-                        state_path,
-                    )
-                else:
-                    logger.warning(
-                        "checkpoint at %s belongs to a different run; "
-                        "starting fresh",
-                        state_path,
-                    )
+            try:
+                with np.load(state_path) as z:
+                    if str(z["fingerprint"]) == fingerprint:
+                        start_block = int(z["next_block"])
+                        overflow = jnp.int32(int(z["overflow"]))
+                        max_distinct = jnp.int32(int(z["max_distinct"]))
+                        acc = KVBatch(
+                            key_lanes=jnp.asarray(z["key_lanes"]),
+                            values=jnp.asarray(z["values"]),
+                            valid=jnp.asarray(z["valid"]),
+                        )
+                        logger.info(
+                            "resuming from checkpoint at block %d (%s)",
+                            start_block,
+                            state_path,
+                        )
+                    else:
+                        logger.warning(
+                            "checkpoint at %s belongs to a different run; "
+                            "starting fresh",
+                            state_path,
+                        )
+            except Exception as e:  # noqa: BLE001 - truncated/garbled npz
+                # A corrupt snapshot costs a clean restart, never a crash
+                # and never wrong counts (ISSUE 1; the mesh engines'
+                # ShardedCheckpoint additionally falls back to a previous
+                # generation — this single-file engine just starts over).
+                logger.warning(
+                    "checkpoint at %s is unreadable (%s: %s); starting "
+                    "fresh", state_path, type(e).__name__, e,
+                )
+                start_block = 0
+                overflow = jnp.int32(0)
+                max_distinct = jnp.int32(0)
         return start_block, overflow, max_distinct, acc
 
     @staticmethod

@@ -160,7 +160,8 @@ def _run_local(arrs, stages, tile_rows, interpret):
         # how they vary across mesh axes; the sort is elementwise over
         # its own shard, so each output varies exactly like its (aliased)
         # input.  Outside shard_map, vma is absent/empty — plain struct.
-        vma = getattr(jax.typeof(a), "vma", None)
+        typeof = getattr(jax, "typeof", None)  # absent on jax 0.4.x
+        vma = getattr(typeof(a), "vma", None) if typeof else None
         if vma is not None:  # frozenset() (replicated) must pass through
             return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
         return jax.ShapeDtypeStruct(a.shape, a.dtype)

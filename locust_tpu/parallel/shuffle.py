@@ -39,7 +39,7 @@ from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.hash_table import fold_into, reduce_into
-from locust_tpu.parallel.mesh import DATA_AXIS
+from locust_tpu.parallel.mesh import DATA_AXIS, compat_shard_map
 
 logger = logging.getLogger("locust_tpu")
 
@@ -89,6 +89,28 @@ def normalize_round_chunk(chunk, lpr: int, width: int):
     return chunk
 
 
+def checkpoint_digest(arrays: dict) -> str:
+    """Content sha256 over a snapshot's payload entries, key-ordered.
+
+    Covers dtype + shape + raw bytes of every entry, so bit-rot anywhere
+    in the archive — not just zip-structure damage — fails validation.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        v = np.asarray(arrays[k])
+        h.update(k.encode())
+        h.update(str(v.dtype).encode())
+        h.update(str(v.shape).encode())
+        h.update(v.tobytes())
+    return h.hexdigest()
+
+
+class CheckpointInvalid(RuntimeError):
+    """A snapshot file failed validation (corrupt/truncated/mismatched)."""
+
+
 class ShardedCheckpoint:
     """Per-process atomic-npz snapshot protocol for sharded engine state.
 
@@ -98,10 +120,19 @@ class ShardedCheckpoint:
     backlog, the round cursor, the run fingerprint, and whatever extra
     host counters the engine passes — restored as-is, so each engine
     keeps its own counter schema while sharing load/replace/atomicity.
+
+    Durability (ISSUE 1): every snapshot embeds a content sha256 and the
+    PREVIOUS generation is kept as ``<state>.prev.npz``.  ``load``
+    VALIDATES before trusting — a truncated archive, a flipped bit, or a
+    wrong-run fingerprint makes that candidate unusable and load falls
+    back to the previous good generation, then to a clean fresh start;
+    it never crashes the run and never resumes wrong state.  Chaos
+    coverage: tests/test_faults.py corrupts snapshots both directly and
+    via the ``io.checkpoint`` fault site.
     """
 
     _RESERVED = (
-        "fingerprint", "next_round",
+        "fingerprint", "next_round", "checksum",
         "acc_key_lanes", "acc_values", "acc_valid",
         "left_key_lanes", "left_values", "left_valid",
     )
@@ -113,61 +144,77 @@ class ShardedCheckpoint:
         self.path = os.path.join(
             checkpoint_dir, f"state.p{jax.process_index()}.npz"
         )
+        self.prev_path = self.path + ".prev.npz"
         self.fingerprint = fingerprint
         self.sharding = sharding
 
     def load(self):
-        """Returns ``(start_round, extras, acc, leftover)`` from a
-        matching snapshot, or None (missing / different-run)."""
+        """Returns ``(start_round, extras, acc, leftover)`` from the newest
+        VALID matching snapshot (current, else previous generation), or
+        None (missing / different run / all candidates corrupt)."""
         import os
 
-        if not os.path.exists(self.path):
-            return None
-        with np.load(self.path) as z:
-            if str(z["fingerprint"]) != self.fingerprint:
-                logger.warning(
-                    "checkpoint at %s belongs to a different run; "
-                    "starting fresh",
-                    self.path,
-                )
-                return None
-            acc = _scatter_batch_from_host(
-                KVBatch(
-                    key_lanes=z["acc_key_lanes"],
-                    values=z["acc_values"],
-                    valid=z["acc_valid"],
-                ),
-                self.sharding,
+        for path, label in ((self.path, "checkpoint"),
+                            (self.prev_path, "previous-generation checkpoint")):
+            if not os.path.exists(path):
+                continue
+            try:
+                return self._load_validated(path)
+            except CheckpointInvalid as e:
+                # Fall through to the previous generation / fresh start:
+                # a corrupt snapshot must cost re-computation, never a
+                # crash and never wrong counts.
+                logger.warning("%s at %s unusable (%s); falling back",
+                               label, path, e)
+        return None
+
+    def _load_validated(self, path: str):
+        """One candidate: open, checksum-verify, fingerprint-match, restore.
+        Any failure — unreadable archive, missing keys, content digest
+        mismatch, foreign fingerprint — raises CheckpointInvalid."""
+        try:
+            with np.load(path) as z:
+                host = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001 - truncated/garbled zip, bad pickle header, ...
+            raise CheckpointInvalid(f"unreadable npz: {type(e).__name__}: {e}")
+        try:
+            fingerprint = str(host.pop("fingerprint"))
+            recorded = str(host.pop("checksum"))
+            payload = dict(host)
+            start_round = int(host.pop("next_round"))
+            acc_h = KVBatch(
+                key_lanes=host.pop("acc_key_lanes"),
+                values=host.pop("acc_values"),
+                valid=host.pop("acc_valid"),
             )
-            leftover = _scatter_batch_from_host(
-                KVBatch(
-                    key_lanes=z["left_key_lanes"],
-                    values=z["left_values"],
-                    valid=z["left_valid"],
-                ),
-                self.sharding,
+            left_h = KVBatch(
+                key_lanes=host.pop("left_key_lanes"),
+                values=host.pop("left_values"),
+                valid=host.pop("left_valid"),
             )
-            extras = {
-                k: z[k] for k in z.files if k not in self._RESERVED
-            }
-            start_round = int(z["next_round"])
+        except KeyError as e:
+            raise CheckpointInvalid(f"snapshot missing entry {e}")
+        if checkpoint_digest(payload) != recorded:
+            raise CheckpointInvalid("content sha256 mismatch (bit-rot?)")
+        if fingerprint != self.fingerprint:
+            raise CheckpointInvalid("belongs to a different run")
+        acc = _scatter_batch_from_host(acc_h, self.sharding)
+        leftover = _scatter_batch_from_host(left_h, self.sharding)
+        extras = {k: v for k, v in host.items()}
         logger.info(
-            "resuming from checkpoint at round %d (%s)",
-            start_round,
-            self.path,
+            "resuming from checkpoint at round %d (%s)", start_round, path
         )
         return start_round, extras, acc, leftover
 
     def snapshot(self, next_round: int, acc, leftover, **extras) -> None:
         """One atomically-replaced npz: table, backlog, cursor and
-        counters can never tear apart."""
+        counters can never tear apart.  The outgoing generation survives
+        as ``.prev.npz`` so one corrupted write never strands the run."""
         import os
 
         acc_h = _gather_batch_host(acc)
         left_h = _gather_batch_host(leftover)
-        tmp = self.path + ".tmp.npz"
-        np.savez_compressed(
-            tmp,
+        payload = dict(
             acc_key_lanes=acc_h.key_lanes,
             acc_values=acc_h.values,
             acc_valid=acc_h.valid,
@@ -175,10 +222,23 @@ class ShardedCheckpoint:
             left_values=left_h.values,
             left_valid=left_h.valid,
             next_round=np.int64(next_round),
-            fingerprint=np.str_(self.fingerprint),
             **extras,
         )
+        tmp = self.path + ".tmp.npz"
+        np.savez_compressed(
+            tmp,
+            fingerprint=np.str_(self.fingerprint),
+            checksum=np.str_(checkpoint_digest(payload)),
+            **payload,
+        )
+        if os.path.exists(self.path):
+            os.replace(self.path, self.prev_path)
         os.replace(tmp, self.path)
+        # Chaos: io.checkpoint corruption/truncation of the just-written
+        # snapshot (no-op without an active plan) — load() must fall back.
+        from locust_tpu.utils import faultplan
+
+        faultplan.damage_file("io.checkpoint", self.path)
 
 
 def stream_checkpoint_fingerprint(
@@ -432,6 +492,12 @@ def build_shuffle_step(
         return new_acc, new_leftover, shuf_ovf, distinct, backlog
 
     def local_step(lines: jax.Array, acc: KVBatch, leftover: KVBatch):
+        from locust_tpu.ops.process_stage import mesh_step_scope
+
+        with mesh_step_scope():
+            return _local_step_body(lines, acc, leftover)
+
+    def _local_step_body(lines: jax.Array, acc: KVBatch, leftover: KVBatch):
         """Per-device body (runs under shard_map): feed + on-device drain.
 
         VERDICT r2 weak #3: the drain loop used to live on the HOST,
@@ -641,7 +707,7 @@ class DistributedMapReduce:
         # engine's round step takes the same conditional, and this
         # engine's outputs are oracle-tested per mode.
         self._step = jax.jit(
-            jax.shard_map(
+            compat_shard_map(
                 local_step,
                 mesh=mesh,
                 in_specs=(P(axis), kv_spec, kv_spec),

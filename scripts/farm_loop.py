@@ -129,6 +129,14 @@ def probe() -> bool:
 
 def run(cmd: list[str], timeout: float, env: dict | None = None) -> int:
     log(f"run: {' '.join(cmd)} (timeout {timeout:.0f}s)")
+    # Child writes pinned to the same ledger this loop READS and commits
+    # (ADVICE r5): bench/sweep children append through artifacts_dir(),
+    # which honors an inherited $LOCUST_ARTIFACTS_DIR — launched with
+    # that set, they would land evidence elsewhere while bench_stale()
+    # and the phase skips watch LEDGER, so every window would re-pay its
+    # compiles and the commit loop would push nothing.
+    env = dict(os.environ if env is None else env)
+    env["LOCUST_ARTIFACTS_DIR"] = os.path.dirname(LEDGER)
     try:
         r = subprocess.run(
             cmd, cwd=REPO, timeout=timeout, env=env,

@@ -108,12 +108,23 @@ def main() -> int:
 
     done_rows = session_done_checks()
 
-    def _skip(name: str) -> bool:
-        if name in done_rows:
-            print(f"[tpu_checks] {name}: already answered this session; "
-                  f"skipping", file=sys.stderr, flush=True)
-            return True
-        return False
+    def _skip(name: str, want_n: int | None = None) -> bool:
+        row = done_rows.get(name)
+        if row is None:
+            return False
+        if want_n is not None and row.get("n") != want_n:
+            # Shape guard (ADVICE r5, matching check 3's reuse guard): a
+            # session-valid row captured at a DIFFERENT n (e.g. a manual
+            # small-N spot check) must not retire this run's ladder —
+            # primitive timings are strongly shape-dependent, and its
+            # tiles dict would seed check 5's baseline at the wrong shape.
+            print(f"[tpu_checks] {name}: prior row is at n="
+                  f"{row.get('n')} != {want_n}; re-running",
+                  file=sys.stderr, flush=True)
+            return False
+        print(f"[tpu_checks] {name}: already answered this session; "
+              f"skipping", file=sys.stderr, flush=True)
+        return True
 
     # 1. Pallas kernel compiles + runs for real, and matches the jnp path.
     jit_tokenize = jax.jit(tokenize_block, static_argnames=("cfg",))
@@ -278,7 +289,7 @@ def main() -> int:
         # 4. Tile sweep: where is the VMEM-residency/round-trip knee?
         # The default tile reuses check 3's verified measurement — a
         # flapping window should spend its seconds on the NEW points.
-        if not _skip("bitonic_tile_ab"):
+        if not _skip("bitonic_tile_ab", want_n=n):
             tiles = {str(TILE_ROWS): {"ms": row["bitonic_ms"],
                                       "compile_s": 0.0,
                                       "note": "from bitonic_sort_ab"}}
@@ -297,7 +308,7 @@ def main() -> int:
         # Mosaic on 2026-07-31 — but that crash predates the int32-mask
         # rewrite, so this ladder measures whether the cap is still
         # needed and what it costs.
-        if not _skip("bitonic_fused_ab"):
+        if not _skip("bitonic_fused_ab", want_n=n):
             from locust_tpu.config import BITONIC_MAX_FUSED
 
             fused = {str(BITONIC_MAX_FUSED): {

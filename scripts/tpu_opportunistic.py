@@ -64,6 +64,32 @@ def _answered_variant_letters(n_rows: int) -> set:
     return answered
 
 
+def battery_answered() -> bool:
+    """True iff the Pallas check battery needs no re-run this session.
+
+    Requires BOTH a session-valid ``battery_complete`` marker AND usable
+    rows for the battery's key checks (ADVICE r5): tpu_checks records
+    battery_complete unconditionally at the end of main(), including
+    when a transient Mosaic/tunnel failure left only error rows — the
+    marker alone would mute the battery for 24h and _row_usable's
+    re-attempt policy could never fire.  session_done_checks applies the
+    same _session_row_ok + _row_usable rules as the battery's own
+    per-check resume, so the two skip policies cannot diverge.
+    """
+    import tpu_checks
+
+    from locust_tpu.utils.artifacts import latest_row_ts
+
+    if latest_row_ts(
+        "tpu_check",
+        where=lambda r: (r.get("check") == "battery_complete"
+                         and opp_resume._session_row_ok(r)),
+    ) <= 0:
+        return False
+    key_checks = {"pallas_tokenizer_tpu", "map_ab"}
+    return key_checks <= set(tpu_checks.session_done_checks())
+
+
 def _run_phase(name: str, cmd: list, env: dict, timeout: float) -> None:
     """One subprocess phase; a timeout or crash here must not kill the
     phases behind it (a 560s variant overrun crashed the whole 07-31
@@ -112,8 +138,6 @@ def main() -> int:
     # restarts), with a session-ts floor for legacy unstamped rows — the
     # ONE validity rule, opp_resume._session_row_ok, shared by both
     # sweep entry points.
-    from locust_tpu.utils.artifacts import latest_row_ts
-
     priority = ("J", "K", "H", "I", "G", "C", "B", "D", "E", "F")
     answered = _answered_variant_letters(sweep_n)
     if not {"J", "K", "H"} - answered:
@@ -159,14 +183,9 @@ def main() -> int:
 
     # Pallas check battery (separate process: own jit namespace) —
     # fused/tile ladders + tokenize checks, the window's long tail.
-    # Only the battery-COMPLETE marker retires it: tpu_checks appends
-    # one row per check, and a battery killed mid-run leaves crumb rows
-    # that must not suppress the unrun checks next window.
-    if latest_row_ts(
-        "tpu_check",
-        where=lambda r: (r.get("check") == "battery_complete"
-                         and opp_resume._session_row_ok(r)),
-    ) > 0:
+    # Retired by battery_answered(): the COMPLETE marker plus usable key
+    # rows, so an error-only battery is re-attempted next window.
+    if battery_answered():
         print("[opp] tpu_checks already answered this session; skipping",
               file=sys.stderr)
     else:

@@ -551,3 +551,25 @@ def test_evidence_tuning_adopts_table_size_jointly(tmp_path, monkeypatch, capsys
         ) + "\n")
     tuned = bench._evidence_tuned_tpu_defaults(static)
     assert tuned["table_size"] == 16384
+
+
+def test_evidence_readers_match_config_ab_kinds(tmp_path, monkeypatch):
+    """ADVICE r5: bench's per-kind evidence reads are derived from the
+    shared artifacts.CONFIG_AB_KINDS tuple, and a drift between the two
+    fails loudly instead of leaving the committed headline stale."""
+    from locust_tpu.utils import artifacts
+    from locust_tpu.utils.artifacts import CONFIG_AB_KINDS
+
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    defaults = {"sort_mode": "hashp2", "block_lines": 32768}
+    # Empty ledger: every kind consulted, defaults returned unchanged.
+    assert bench._evidence_tuned_tpu_defaults(defaults) == defaults
+    # Drift (a kind added to the shared tuple without a bench reader)
+    # must raise, not silently skip the new kind.
+    monkeypatch.setattr(
+        artifacts, "CONFIG_AB_KINDS", CONFIG_AB_KINDS + ("new_kind_ab",)
+    )
+    with pytest.raises(RuntimeError, match="drifted"):
+        bench._evidence_tuned_tpu_defaults(defaults)

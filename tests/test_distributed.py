@@ -406,7 +406,9 @@ def test_bitonic_kernel_traces_under_shard_map():
     k = (jnp.arange(8 * 2048, dtype=jnp.uint32)
          * jnp.uint32(2654435761)) % jnp.uint32(977)
     v = jnp.arange(8 * 2048, dtype=jnp.uint32)
-    f = jax.jit(jax.shard_map(
+    from locust_tpu.parallel.mesh import compat_shard_map
+
+    f = jax.jit(compat_shard_map(
         body, mesh=mesh, in_specs=(P("d"), P("d")),
         out_specs=(P("d"), P("d")), check_vma=False,
     ))

@@ -183,3 +183,29 @@ def test_bench_stale_on_newer_tuning_inputs(monkeypatch, tmp_path):
     assert m.bench_stale() is True   # tuning input postdates the bench
     write([{"kind": "bench", "backend": "tpu", "ts": now - 7200}])
     assert m.bench_stale() is True   # the 1h repeat-measurement rule
+
+
+def test_run_pins_artifacts_dir_to_ledger(monkeypatch, tmp_path):
+    """ADVICE r5: child jobs (bench/sweep) must write evidence through the
+    same ledger the loop reads and commits — an inherited
+    $LOCUST_ARTIFACTS_DIR would silently divert their rows."""
+    m = _load(monkeypatch, tmp_path)
+    seen = {}
+
+    def fake_run(cmd, cwd=None, timeout=None, env=None, **kw):
+        seen["env"] = env
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    monkeypatch.setattr(m.subprocess, "run", fake_run)
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", "/somewhere/else")
+    assert m.run(["echo", "x"], timeout=5) == 0
+    assert seen["env"]["LOCUST_ARTIFACTS_DIR"] == os.path.dirname(m.LEDGER)
+    # an explicit env dict is pinned too
+    m.run(["echo", "x"], timeout=5,
+          env={"LOCUST_ARTIFACTS_DIR": "/elsewhere", "KEEP": "1"})
+    assert seen["env"]["LOCUST_ARTIFACTS_DIR"] == os.path.dirname(m.LEDGER)
+    assert seen["env"]["KEEP"] == "1"

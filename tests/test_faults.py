@@ -1,0 +1,692 @@
+"""Chaos matrix: the distributor + checkpoint paths under injected faults.
+
+ISSUE 1 contract: for EVERY fault class the deterministic fault plan can
+inject (connect refusal, frame corruption/truncation, worker crash
+mid-map, stragglers, corrupted intermediate chunks, corrupted/truncated
+checkpoints), the distributed WordCount job either produces BYTE-IDENTICAL
+output to the fault-free run or raises a structured ``MasterError`` —
+never a hang (everything here is bounded by small socket/RPC timeouts)
+and never silent corruption.
+
+All loopback, in-proc map runners (shared JAX runtime), tiny corpus.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from helpers import py_wordcount
+
+from locust_tpu import cli
+from locust_tpu.distributor import master, protocol
+from locust_tpu.distributor.master import (
+    IntegrityError,
+    JobResult,
+    MasterError,
+    WorkerHealth,
+)
+from locust_tpu.distributor.worker import Worker
+from locust_tpu.utils import faultplan
+
+SECRET = b"chaos-secret"
+
+CORPUS = b"""alpha beta gamma
+beta gamma delta
+gamma delta epsilon
+delta epsilon alpha
+epsilon alpha beta
+zeta eta theta iota
+"""
+
+# Small, bounded control-plane timings: a hung test IS a failed test.
+WORKER_KW = dict(secret=SECRET, conn_timeout=3.0)
+JOB_KW = dict(
+    rpc_timeout=15.0,
+    heartbeat_interval=0.2,
+    poll_s=0.02,
+    max_retries=2,
+)
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(CORPUS)
+    return str(p)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A chaos plan must never leak across tests."""
+    yield
+    faultplan.deactivate()
+
+
+def make_inproc_runner():
+    """Map runner invoking the CLI in-process (fast: shared JAX runtime)."""
+
+    def runner(req):
+        rc = cli.main(
+            [
+                req["file"],
+                str(req["line_start"]),
+                str(req["line_end"]),
+                str(req["node_num"]),
+                "1",
+                "-i",
+                req["intermediate"],
+                "--block-lines", "8",
+                "--line-width", "64",
+                "--emits-per-line", "8",
+                "--no-timing",
+            ]
+        )
+        return {"status": "ok" if rc == 0 else "error", "returncode": rc,
+                "log": "", "intermediate": req["intermediate"]}
+
+    return runner
+
+
+def _shutdown(w: Worker):
+    try:
+        master._rpc(w.addr, {"cmd": "shutdown"}, SECRET, timeout=5)
+    except Exception:
+        pass
+
+
+def _reduce_bytes(corpus_file, tsvs, capsysbinary) -> bytes:
+    """Stage-2 reduce over the collected TSVs; returns raw stdout bytes."""
+    capsysbinary.readouterr()
+    rc = cli.main(
+        [corpus_file, "-1", "-1", "0", "2", "--block-lines", "8",
+         "--line-width", "64", "--emits-per-line", "8", "--no-timing"]
+        + sum((["-i", t] for t in tsvs), [])
+    )
+    assert rc == 0
+    return capsysbinary.readouterr().out
+
+
+def _run_wordcount(corpus_file, tmp_path, capsysbinary, plan=None,
+                   n_workers=2, job_kw=None, rpc=None):
+    """Full loopback job (optionally under a fault plan) -> (bytes, JobResult)."""
+    runner = make_inproc_runner()
+    workers = [Worker(map_runner=runner, **WORKER_KW) for _ in range(n_workers)]
+    for w in workers:
+        w.serve_in_thread()
+    kw = dict(JOB_KW, **(job_kw or {}))
+    # Fast, fresh health per job: short backoffs keep the chaos matrix
+    # quick without changing the scheduling logic under test.
+    kw.setdefault(
+        "health", WorkerHealth(n_workers, base_s=0.05, cap_s=2.0, seed=1)
+    )
+    if rpc is not None:
+        kw["rpc"] = rpc
+    try:
+        if plan is not None:
+            with faultplan.active_plan(plan):
+                res = master.run_job(
+                    [w.addr for w in workers], corpus_file, SECRET,
+                    workdir=str(tmp_path / "m"), **kw,
+                )
+        else:
+            res = master.run_job(
+                [w.addr for w in workers], corpus_file, SECRET,
+                workdir=str(tmp_path / "m"), **kw,
+            )
+        out = _reduce_bytes(corpus_file, res, capsysbinary)
+        return out, res, workers
+    finally:
+        for w in workers:
+            _shutdown(w)
+
+
+def plan(rules, seed=7) -> faultplan.FaultPlan:
+    return faultplan.FaultPlan(rules, seed=seed)
+
+
+# --------------------------------------------------------------- plan parsing
+
+
+def test_fault_plan_parse_sources(tmp_path, monkeypatch):
+    spec = '{"seed": 5, "rules": [{"site": "rpc.connect", "action": "refuse"}]}'
+    p = faultplan.FaultPlan.parse(spec)
+    assert p.seed == 5 and p.rules[0].site == "rpc.connect"
+    f = tmp_path / "plan.json"
+    f.write_text(spec)
+    assert faultplan.FaultPlan.parse(str(f)).seed == 5
+    # env activation (install), and explicit spec winning over env
+    monkeypatch.setenv(faultplan.ENV_VAR, spec)
+    try:
+        got = faultplan.install()
+        assert got is not None and faultplan.active() is got
+    finally:
+        faultplan.deactivate()
+    monkeypatch.delenv(faultplan.ENV_VAR)
+    assert faultplan.install() is None  # nothing to install
+    assert faultplan.active() is None
+
+
+def test_fault_plan_rejects_typos():
+    with pytest.raises(ValueError, match="unknown site"):
+        plan([{"site": "rpc.conect", "action": "refuse"}])
+    with pytest.raises(ValueError, match="invalid for site"):
+        plan([{"site": "rpc.connect", "action": "corrupt"}])
+    with pytest.raises(ValueError, match="unknown keys"):
+        plan([{"site": "rpc.connect", "action": "refuse", "portt": 1}])
+    with pytest.raises(ValueError, match="prob"):
+        plan([{"site": "rpc.connect", "action": "refuse", "prob": 0.0}])
+    with pytest.raises(ValueError, match="delay_s"):
+        plan([{"site": "rpc.delay", "action": "delay"}])
+
+
+def test_fault_plan_deterministic_decisions_and_mutations():
+    spec = [{"site": "rpc.frame", "action": "corrupt", "prob": 0.5}]
+    runs = []
+    for _ in range(2):
+        p = plan(spec, seed=11)
+        with faultplan.active_plan(p):
+            runs.append([
+                faultplan.mangle("rpc.frame", bytes(range(256)), keep_prefix=4)
+                for _ in range(20)
+            ])
+    assert runs[0] == runs[1]  # same seed -> same gates, same byte flips
+    assert any(r != bytes(range(256)) for r in runs[0])  # fired sometimes
+    assert any(r == bytes(range(256)) for r in runs[0])  # and skipped sometimes
+    # a different seed decides differently
+    p = plan(spec, seed=12)
+    with faultplan.active_plan(p):
+        other = [
+            faultplan.mangle("rpc.frame", bytes(range(256)), keep_prefix=4)
+            for _ in range(20)
+        ]
+    assert other != runs[0]
+
+
+def test_hooks_are_noops_without_plan():
+    data = b"payload-bytes"
+    assert faultplan.mangle("rpc.frame", data) is data  # not even a copy
+    assert faultplan.fire("worker.map", shard=0) is None
+    faultplan.check_connect("h", 1)   # no raise
+    faultplan.delay("rpc.delay", cmd="map")  # no sleep
+    faultplan.damage_file("io.checkpoint", "/nonexistent")  # no touch
+
+
+# ---------------------------------------------------- health unit (fake clock)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_worker_health_exponential_backoff_fake_clock():
+    clk = FakeClock()
+    h = WorkerHealth(2, clock=clk, base_s=1.0, cap_s=8.0, jitter=0.0, seed=1)
+    assert h.healthy(0) and not h.quarantined(0)
+    assert h.fail(0) == 1.0
+    assert h.quarantined(0) and not h.probe_due(0) and not h.healthy(0)
+    clk.advance(0.5)
+    assert not h.probe_due(0)
+    clk.advance(0.6)
+    assert h.probe_due(0)        # backoff expired: eligible for a probe
+    assert not h.healthy(0)      # ...but NOT healthy until a good pong
+    # consecutive failures double, capped at cap_s
+    assert h.fail(0) == 2.0
+    assert h.fail(0) == 4.0
+    assert h.fail(0) == 8.0
+    assert h.fail(0) == 8.0
+    # recovery clears the slate entirely
+    h.ok(0)
+    assert h.healthy(0) and h.failures(0) == 0
+    assert h.fail(0) == 1.0
+    # worker 1 was never touched
+    assert h.healthy(1)
+
+
+def test_worker_health_jitter_deterministic_and_bounded():
+    clk = FakeClock()
+    a = WorkerHealth(1, clock=clk, base_s=1.0, jitter=0.5, seed=3)
+    b = WorkerHealth(1, clock=clk, base_s=1.0, jitter=0.5, seed=3)
+    backs = [a.fail(0) for _ in range(4)]
+    assert backs == [b.fail(0) for _ in range(4)]  # seeded, reproducible
+    for i, back in enumerate(backs):
+        base = min(8.0 * 4, 1.0 * 2**i)
+        assert base <= back <= base * 1.5  # jitter stretches, never shrinks
+    c = WorkerHealth(1, clock=clk, base_s=1.0, jitter=0.5, seed=4)
+    assert [c.fail(0) for _ in range(4)] != backs  # different seed, different noise
+
+
+def test_heartbeat_unquarantines_recovered_worker():
+    """The heartbeat loop pings a quarantine-expired worker and clears it."""
+    import threading
+
+    h = WorkerHealth(1, base_s=0.01, jitter=0.0)
+    h.fail(0)
+    stop = threading.Event()
+    pings = []
+
+    def rpc(node, req, secret):
+        pings.append(req["cmd"])
+        return {"status": "ok", "pong": True}
+
+    t = threading.Thread(
+        target=master._heartbeat_loop,
+        args=(stop, h, [("127.0.0.1", 1)], rpc, SECRET, 0.02),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not h.healthy(0) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    t.join(timeout=2)
+    assert h.healthy(0), "heartbeat should un-quarantine on a good pong"
+    assert "ping" in pings
+
+
+def test_heartbeat_deepens_backoff_while_down():
+    import threading
+
+    h = WorkerHealth(1, base_s=0.01, jitter=0.0)
+    h.fail(0)
+    stop = threading.Event()
+
+    def rpc(node, req, secret):
+        raise ConnectionRefusedError("still down")
+
+    t = threading.Thread(
+        target=master._heartbeat_loop,
+        args=(stop, h, [("127.0.0.1", 1)], rpc, SECRET, 0.02),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while h.failures(0) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    t.join(timeout=2)
+    assert h.failures(0) >= 3 and not h.healthy(0)
+
+
+# ------------------------------------------------------------- chaos matrix
+
+
+def _fault_free(corpus_file, tmp_path, capsysbinary):
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "clean", capsysbinary
+    )
+    # sanity: matches the oracle too
+    got = {k: int(v) for k, _, v in
+           (line.partition(b"\t") for line in out.splitlines())}
+    assert got == dict(py_wordcount(CORPUS.splitlines(), 8))
+    return out
+
+
+def test_chaos_connect_refusal_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # Refuse the first two connects anywhere: the shard fails over.
+    p = plan([{"site": "rpc.connect", "action": "refuse", "times": 2}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 2
+
+
+def test_chaos_frame_corruption_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # One corrupted map frame: HMAC rejects it, the connection drops, the
+    # shard is retried — output unchanged.
+    p = plan([{"site": "rpc.frame", "action": "corrupt",
+               "match": {"cmd": "map"}, "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 1
+
+
+def test_chaos_frame_truncation_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # One truncated map frame: the worker's bounded read times out (3s),
+    # it answers a structured error, the shard is retried.
+    p = plan([{"site": "rpc.frame", "action": "truncate",
+               "match": {"cmd": "map"}, "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+
+
+def test_chaos_worker_crash_mid_map_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # Shard 0's first map attempt dies like a SIGKILL (connection dropped,
+    # no reply); the master reassigns it.
+    p = plan([{"site": "worker.map", "action": "crash",
+               "match": {"shard": 0}, "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    shard0 = next(s for s in res.shards if s.shard == 0)
+    assert len(shard0.attempts) >= 2  # the crash cost an attempt
+
+
+def test_chaos_map_error_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    p = plan([{"site": "worker.map", "action": "error",
+               "match": {"shard": 1}, "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+
+
+def test_chaos_straggler_speculative_backup_wins(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # Every map is delayed 6s on whichever worker serves shard 1's home.
+    # We can't know the ephemeral port up front, so key the delay on the
+    # shard instead: shard 1's FIRST map attempt stalls; the speculative
+    # backup on the other worker wins long before the stall ends.
+    # The stall (12s) comfortably exceeds a warm in-proc map (~1-2s incl.
+    # re-trace), so the backup must win; the elapsed bound proves the job
+    # never waited the stall out (it includes the reduce + teardown).
+    p = plan([{"site": "rpc.delay", "action": "delay",
+               "match": {"cmd": "map", "shard": 1}, "times": 1,
+               "delay_s": 12.0}])
+    t0 = time.monotonic()
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p,
+        job_kw=dict(speculate_after=0.4),
+    )
+    elapsed = time.monotonic() - t0
+    assert out == want
+    shard1 = next(s for s in res.shards if s.shard == 1)
+    assert shard1.speculated, "straggling shard should have speculated"
+    # first finisher wins: the stalled PRIMARY lost, the backup won
+    assert shard1.attempts[0]["outcome"] == "cancelled"
+    winner = next(a for a in shard1.attempts if a["outcome"] == "ok")
+    assert winner["speculative"]
+    assert elapsed < 11.0
+
+
+def test_chaos_intermediate_corruption_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    # One fetch chunk rots on 'disk': the end-to-end sha256 (recorded at
+    # map time) catches it, the worker is quarantined, the shard re-runs.
+    p = plan([{"site": "io.intermediate", "action": "corrupt", "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 1
+    outcomes = [a["outcome"] for s in res.shards for a in s.attempts]
+    assert "integrity" in outcomes
+
+
+def test_chaos_everything_down_structured_error(corpus_file, tmp_path):
+    """When no worker can ever serve, the job fails FAST with MasterError
+    — the structured arm of the matrix contract (not a hang)."""
+    runner = make_inproc_runner()
+    w1 = Worker(map_runner=runner, **WORKER_KW)
+    w2 = Worker(map_runner=runner, **WORKER_KW)
+    w1.serve_in_thread()
+    w2.serve_in_thread()
+    p = plan([{"site": "rpc.connect", "action": "refuse"}])  # unlimited
+    try:
+        t0 = time.monotonic()
+        with faultplan.active_plan(p):
+            with pytest.raises(MasterError, match="failed on every tried"):
+                master.run_job(
+                    [w1.addr, w2.addr], corpus_file, SECRET,
+                    workdir=str(tmp_path / "m"),
+                    health=WorkerHealth(2, base_s=0.05, cap_s=0.5, seed=1),
+                    **JOB_KW,
+                )
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        _shutdown(w1)
+        _shutdown(w2)
+
+
+def test_chaos_persistent_corruption_structured_error(corpus_file, tmp_path):
+    """Corruption on EVERY fetch chunk: integrity verification must turn
+    would-be silent corruption into a structured MasterError."""
+    runner = make_inproc_runner()
+    w1 = Worker(map_runner=runner, **WORKER_KW)
+    w2 = Worker(map_runner=runner, **WORKER_KW)
+    w1.serve_in_thread()
+    w2.serve_in_thread()
+    p = plan([{"site": "io.intermediate", "action": "corrupt"}])  # unlimited
+    try:
+        with faultplan.active_plan(p):
+            with pytest.raises(MasterError):
+                master.run_job(
+                    [w1.addr, w2.addr], corpus_file, SECRET,
+                    workdir=str(tmp_path / "m"),
+                    health=WorkerHealth(2, base_s=0.05, cap_s=0.5, seed=1),
+                    **JOB_KW,
+                )
+        assert p.rules[0].fired >= 1
+    finally:
+        _shutdown(w1)
+        _shutdown(w2)
+
+
+def test_master_detects_tampered_chunk_via_chunk_digest(corpus_file, tmp_path, capsysbinary):
+    """Per-chunk sha256: a chunk tampered BETWEEN worker and master (after
+    the worker hashed it) is caught immediately, shard reassigned."""
+    import base64
+
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    tampered = {"n": 0}
+
+    def tampering_rpc(node, req, secret):
+        resp = master._rpc(node, req, secret, timeout=JOB_KW["rpc_timeout"])
+        if req.get("cmd") == "fetch" and tampered["n"] == 0 and resp.get("data_b64"):
+            raw = bytearray(base64.b64decode(resp["data_b64"]))
+            if raw:
+                raw[0] ^= 0xFF
+                resp["data_b64"] = base64.b64encode(bytes(raw)).decode()
+                tampered["n"] += 1
+        return resp
+
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, rpc=tampering_rpc
+    )
+    assert out == want
+    assert tampered["n"] == 1
+    outcomes = [a["outcome"] for s in res.shards for a in s.attempts]
+    assert "integrity" in outcomes
+
+
+def test_job_result_is_still_a_path_list(corpus_file, tmp_path, capsysbinary):
+    """Back-compat: JobResult behaves as the list of TSV paths, with the
+    per-shard timing stats riding along (ISSUE 1 'stats in job result')."""
+    out, res, _ = _run_wordcount(corpus_file, tmp_path, capsysbinary)
+    assert isinstance(res, JobResult) and isinstance(res, list)
+    assert len(res) == 2 and all(os.path.exists(t) for t in res)
+    assert len(res.shards) == 2
+    for s in res.shards:
+        assert s.winner is not None and s.elapsed_s > 0
+        assert s.attempts and s.attempts[0]["t1"] is not None
+        assert s.as_dict()["shard"] == s.shard
+
+
+# ----------------------------------------------------- checkpoint corruption
+
+import jax  # noqa: E402
+
+from locust_tpu.config import EngineConfig  # noqa: E402
+from locust_tpu.core import bytes_ops  # noqa: E402
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _mesh_cfg():
+    return EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+
+
+def _mesh_fixture(tmp_path):
+    """A mesh engine mid-corpus with two checkpoint generations on disk."""
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.parallel.shuffle import DistributedMapReduce
+
+    cfg = _mesh_cfg()
+    lines = [b"alpha beta", b"beta gamma", b"alpha delta epsilon"] * 40
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    mesh = make_mesh(8)
+    want = dict(DistributedMapReduce(mesh, cfg).run(rows).to_host_pairs())
+
+    ckpt = str(tmp_path / "dckpt")
+    dmr = DistributedMapReduce(mesh, cfg)
+    real_step = dmr._step
+    calls = {"n": 0}
+
+    def dying_step(lines_, acc, leftover):
+        if calls["n"] == 2:
+            raise RuntimeError("simulated crash")
+        calls["n"] += 1
+        return real_step(lines_, acc, leftover)
+
+    dmr._step = dying_step
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    dmr._step = real_step
+    state = os.path.join(ckpt, f"state.p{jax.process_index()}.npz")
+    prev = state + ".prev.npz"
+    assert os.path.exists(state) and os.path.exists(prev)
+    return dmr, rows, ckpt, state, prev, want
+
+
+@needs8
+def test_mesh_checkpoint_truncated_falls_back_to_prev(tmp_path, caplog):
+    """A truncated current snapshot: resume falls back to the previous
+    good generation — exact counts, no crash (ISSUE 1 tentpole)."""
+    import logging
+
+    dmr, rows, ckpt, state, prev, want = _mesh_fixture(tmp_path)
+    data = open(state, "rb").read()
+    open(state, "wb").write(data[: len(data) // 2])
+    with caplog.at_level(logging.WARNING, logger="locust_tpu"):
+        res = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res.to_host_pairs()) == want
+    assert any("unusable" in r.message for r in caplog.records)
+
+
+@needs8
+def test_mesh_checkpoint_both_generations_corrupt_fresh_start(tmp_path):
+    """Current AND previous snapshots corrupt: clean fresh start, never
+    wrong counts."""
+    dmr, rows, ckpt, state, prev, want = _mesh_fixture(tmp_path)
+    for path in (state, prev):
+        data = bytearray(open(path, "rb").read())
+        for i in range(0, len(data), 37):  # scribble everywhere
+            data[i] ^= 0x5A
+        open(path, "wb").write(bytes(data))
+    res = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res.to_host_pairs()) == want
+
+
+@needs8
+def test_mesh_checkpoint_bad_checksum_detected(tmp_path):
+    """A snapshot whose arrays load fine but whose content digest does not
+    match is rejected (bit-rot the zip layer cannot see)."""
+    from locust_tpu.parallel.shuffle import (
+        CheckpointInvalid,
+        ShardedCheckpoint,
+    )
+
+    dmr, rows, ckpt, state, prev, want = _mesh_fixture(tmp_path)
+    with np.load(state) as z:
+        entries = {k: z[k] for k in z.files}
+    entries["checksum"] = np.str_("0" * 64)  # wrong digest, valid archive
+    np.savez_compressed(state + ".tmp.npz", **entries)
+    os.replace(state + ".tmp.npz", state)
+    sc = ShardedCheckpoint.__new__(ShardedCheckpoint)
+    sc.fingerprint = str(entries["fingerprint"])
+    sc.sharding = None  # _load_validated raises before scattering
+    with pytest.raises(CheckpointInvalid, match="sha256 mismatch"):
+        sc._load_validated(state)
+    # end-to-end: the run falls back to prev and stays exact
+    res = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res.to_host_pairs()) == want
+
+
+@needs8
+def test_mesh_checkpoint_stale_fingerprint_prev_rescues(tmp_path):
+    """Another run's snapshot occupies the current slot; the previous
+    generation (ours) still resumes — fingerprints select, not crash."""
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.parallel.shuffle import DistributedMapReduce
+
+    cfg = _mesh_cfg()
+    mesh = make_mesh(8)
+    ckpt = str(tmp_path / "shared")
+    dmr = DistributedMapReduce(mesh, cfg)
+    lines_a = [b"aaa bbb"] * 64
+    rows_a = bytes_ops.strings_to_rows(lines_a, cfg.line_width)
+    dmr.run(rows_a, checkpoint_dir=ckpt)  # run A's snapshot lands
+    # run B fits ONE round (one snapshot): it rotates A's snapshot into
+    # .prev exactly once and installs its own as current.
+    lines_b = [b"ccc ddd"] * 32
+    rows_b = bytes_ops.strings_to_rows(lines_b, cfg.line_width)
+    res_b = dmr.run(rows_b, checkpoint_dir=ckpt)
+    assert dict(res_b.to_host_pairs()) == {b"ccc": 32, b"ddd": 32}
+    # run A again: current snapshot is B's (foreign fingerprint), prev is
+    # A's fully-completed snapshot -> resumes it, zero steps, exact output
+    res_a = dmr.run(rows_a, checkpoint_dir=ckpt)
+    assert dict(res_a.to_host_pairs()) == {b"aaa": 64, b"bbb": 64}
+
+
+@needs8
+def test_chaos_checkpoint_fault_site_never_wrong_counts(tmp_path):
+    """io.checkpoint faults damage EVERY snapshot as written: the run's
+    output is unaffected (snapshots are durability, not correctness) and
+    a resume survives the damaged files via fallback/fresh start."""
+    from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.parallel.shuffle import DistributedMapReduce
+
+    cfg = _mesh_cfg()
+    lines = [b"alpha beta", b"beta gamma"] * 40
+    rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
+    mesh = make_mesh(8)
+    want = dict(DistributedMapReduce(mesh, cfg).run(rows).to_host_pairs())
+    dmr = DistributedMapReduce(mesh, cfg)
+    ckpt = str(tmp_path / "chaos_ckpt")
+    p = plan([{"site": "io.checkpoint", "action": "truncate"}])
+    with faultplan.active_plan(p):
+        res = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res.to_host_pairs()) == want
+    assert p.rules[0].fired >= 1
+    # resume over the damaged snapshots: falls back (possibly to fresh)
+    res2 = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res2.to_host_pairs()) == want
+
+
+def test_engine_checkpoint_truncated_clean_restart(tmp_path):
+    """Single-device engine: a truncated state.npz costs a clean restart
+    with exact counts — never a crash, never wrong counts (satellite)."""
+    from locust_tpu.engine import MapReduceEngine
+
+    cfg = EngineConfig(block_lines=4, line_width=64, emits_per_line=8)
+    eng = MapReduceEngine(cfg)
+    ckpt = str(tmp_path / "eckpt")
+    rows = bytes_ops.strings_to_rows([b"aaa bbb ccc"] * 32, cfg.line_width)
+    eng.run_checkpointed(rows, ckpt, every=2)
+    state = os.path.join(ckpt, "state.npz")
+    data = open(state, "rb").read()
+    open(state, "wb").write(data[: len(data) // 3])
+    res = eng.run_checkpointed(rows, ckpt, every=2)
+    assert dict(res.to_host_pairs()) == {b"aaa": 32, b"bbb": 32, b"ccc": 32}

@@ -337,13 +337,15 @@ def test_hasht_scan_lowers_for_tpu():
     pre-hardware gate the bitonic kernel gets, so a lowering regression
     is caught before it costs a tunnel window."""
     import jax
+    # 0.4.x has the module but not the lazy ``jax.export`` attribute.
+    from jax import export as jax_export
 
     cfg = EngineConfig(
         block_lines=256, sort_mode="hasht", key_width=16, emits_per_line=8
     )
     eng = MapReduceEngine(cfg)
     shape = jax.ShapeDtypeStruct((2, 256, cfg.line_width), jnp.uint8)
-    exp = jax.export.export(eng._scan_blocks, platforms=["tpu"])(shape)
+    exp = jax_export.export(eng._scan_blocks, platforms=["tpu"])(shape)
     assert len(exp.mlir_module()) > 0
 
 

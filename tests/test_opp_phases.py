@@ -392,3 +392,114 @@ def test_tpu_checks_session_done_checks(tmp_path, monkeypatch):
     assert mod._row_usable("bitonic_rescue",
                            {"rungs": {"a": {"error": "x"},
                                       "b": {"ms": 9.0}}})
+
+
+def test_battery_answered_requires_usable_key_rows(tmp_path, monkeypatch):
+    """ADVICE r5: an error-only battery (battery_complete recorded after
+    every check produced only error rows) must NOT retire tpu_checks —
+    the skip needs usable rows for the key checks too."""
+    import importlib.util
+    import json
+    import time
+
+    monkeypatch.setattr(sys, "path", list(sys.path))
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cc")),
+    )
+    spec = importlib.util.spec_from_file_location(
+        "tpu_opportunistic_under_test",
+        os.path.join(REPO, "scripts", "tpu_opportunistic.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 600))
+
+    def write(rows):
+        (led / "tpu_runs.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in rows)
+        )
+
+    # Marker alone (error-only battery): NOT answered.
+    write([
+        {"ts": now - 30, "kind": "tpu_check", "backend": "tpu",
+         "check": "pallas_tokenizer_tpu", "error": "tunnel hiccup"},
+        {"ts": now - 29, "kind": "tpu_check", "backend": "tpu",
+         "check": "map_ab", "error": "tunnel hiccup"},
+        {"ts": now - 28, "kind": "tpu_check", "backend": "tpu",
+         "check": "battery_complete"},
+    ])
+    assert not mod.battery_answered()
+
+    # Usable key rows WITHOUT the marker (battery died mid-run): not
+    # answered either — the unrun tail checks still need their window.
+    write([
+        {"ts": now - 30, "kind": "tpu_check", "backend": "tpu",
+         "check": "pallas_tokenizer_tpu", "matches_jnp": True},
+        {"ts": now - 29, "kind": "tpu_check", "backend": "tpu",
+         "check": "map_ab", "jnp_ms": 5.0, "pallas_ms": 2.0},
+    ])
+    assert not mod.battery_answered()
+
+    # Marker + usable key rows: answered.
+    write([
+        {"ts": now - 30, "kind": "tpu_check", "backend": "tpu",
+         "check": "pallas_tokenizer_tpu", "matches_jnp": True},
+        {"ts": now - 29, "kind": "tpu_check", "backend": "tpu",
+         "check": "map_ab", "jnp_ms": 5.0, "pallas_ms": 2.0},
+        {"ts": now - 28, "kind": "tpu_check", "backend": "tpu",
+         "check": "battery_complete"},
+    ])
+    assert mod.battery_answered()
+
+
+def test_tpu_checks_ladder_skip_requires_matching_n(tmp_path, monkeypatch):
+    """ADVICE r5: a session-valid bitonic_tile_ab/bitonic_fused_ab row at
+    a DIFFERENT n must not retire this run's ladder (primitive timings
+    are shape-dependent; the tiles dict seeds check 5's baseline)."""
+    import importlib.util
+    import json
+    import time
+
+    monkeypatch.setattr(sys, "path", list(sys.path))
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cc")),
+    )
+    spec = importlib.util.spec_from_file_location(
+        "tpu_checks_under_test2", os.path.join(REPO, "scripts",
+                                               "tpu_checks.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    led = tmp_path / "artifacts"
+    led.mkdir()
+    monkeypatch.setenv("LOCUST_ARTIFACTS_DIR", str(led))
+    now = time.time()
+    monkeypatch.setenv("LOCUST_SESSION_TS", str(now - 600))
+    n_run = 65536 + 32768 * 20
+    (led / "tpu_runs.jsonl").write_text(json.dumps(
+        {"ts": now - 20, "kind": "tpu_check", "backend": "tpu",
+         "check": "bitonic_tile_ab", "n": 65536,  # small-N spot check
+         "tiles": {"256": {"ms": 4.0}, "512": {"ms": 5.0}}}
+    ) + "\n")
+    done = mod.session_done_checks()
+    assert "bitonic_tile_ab" in done  # session-valid and usable...
+
+    # ...but the in-main skip must reject it at the run's shape.  Rebuild
+    # the closure logic exactly as main() does.
+    def skip(name, want_n=None):
+        row = done.get(name)
+        if row is None:
+            return False
+        if want_n is not None and row.get("n") != want_n:
+            return False
+        return True
+
+    assert skip("bitonic_tile_ab", want_n=65536)
+    assert not skip("bitonic_tile_ab", want_n=n_run)
