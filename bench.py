@@ -504,6 +504,30 @@ def bench_auto_caps(lines, label: str = "[bench]") -> tuple[int, int]:
     return kw, epl
 
 
+def _dataplane_stats() -> dict:
+    """Distributor data-plane summary for the one-line JSON: the loopback
+    fetch microbench (locust_tpu/distributor/microbench.py — wire bytes,
+    fetch MB/s, compression ratio; docs/DATAPLANE.md).  Pure host/socket
+    work, a couple of seconds, backend-independent.  Guarded: a failure
+    here must never cost the headline line (LOCUST_BENCH_DATAPLANE=0
+    skips it outright)."""
+    if os.environ.get("LOCUST_BENCH_DATAPLANE", "1") == "0":
+        return {"skipped": True}
+    try:
+        from locust_tpu.distributor.microbench import run_microbench
+
+        t0 = time.perf_counter()
+        res = run_microbench(target_bytes=2 << 20, repeats=2)
+        print(
+            f"[bench] dataplane microbench: {res['summary']} "
+            f"({time.perf_counter()-t0:.1f}s)",
+            file=sys.stderr,
+        )
+        return dict(res["summary"], corpus_bytes=res["corpus_bytes"])
+    except Exception as e:  # noqa: BLE001 - the headline line comes first
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_bench(backend: str) -> dict:
     import jax
 
@@ -648,6 +672,7 @@ def run_bench(backend: str) -> dict:
             "hbm_peak_gb_s": roof["hbm_peak_gb_s"],
             "hbm_utilization_pct": roof["hbm_utilization_pct"],
         },
+        "dataplane": _dataplane_stats(),
     }
     if payload["backend"] == "cpu":
         # A CPU fallback is NOT the framework's number — point at the

@@ -44,8 +44,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("stage", nargs="?", type=int, default=STAGE_SINGLE,
                    choices=[STAGE_SINGLE, STAGE_MAP, STAGE_REDUCE])
     p.add_argument("--intermediate", "-i", action="append", default=None,
-                   help="intermediate TSV path(s); default "
+                   help="intermediate path(s); default "
                         f"{DEFAULT_INTERMEDIATE} (reference main.cu:428)")
+    p.add_argument("--inter-format", choices=["tsv", "bin"], default="tsv",
+                   help="stage-1 intermediate format: 'tsv' (reference "
+                        "parity, key\\tvalue text) or 'bin' (packed binary "
+                        "KV, docs/DATAPLANE.md — what the distributor "
+                        "master requests).  Stage 2 sniffs the format per "
+                        "file, so mixed inputs reduce fine.")
     p.add_argument("--block-lines", type=int, default=4096)
     p.add_argument("--line-width", type=int, default=128)
     p.add_argument("--key-width", type=int, default=32)
@@ -353,7 +359,7 @@ def _run(args) -> int:
             with timer.span("output"):
                 if args.stage == STAGE_MAP:
                     out = inter[0]
-                    serde.write_tsv(res.to_host_pairs(), out)
+                    res.dump_intermediate(out, args.inter_format)
                     print(f"[locust] node {args.node_num}: intermediate written to {out}",
                           file=sys.stderr)
                 else:
@@ -367,7 +373,7 @@ def _run(args) -> int:
         with timer.span("load"):
             key_rows_list, values_list = [], []
             for path in inter:
-                k, v = serde.read_tsv(path, cfg.key_width)
+                k, v = serde.read_intermediate(path, cfg.key_width)
                 key_rows_list.append(k)
                 values_list.append(v)
             keys = np.concatenate(key_rows_list) if key_rows_list else np.zeros((0, cfg.key_width), np.uint8)
@@ -531,7 +537,7 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
         with timer.span("output"):
             if args.stage == STAGE_MAP:
                 out = inter[0]
-                serde.write_tsv(pairs, out)
+                serde.write_intermediate(pairs, out, args.inter_format)
                 print(
                     f"[locust] node {args.node_num}: intermediate written "
                     f"to {out}",

@@ -9,8 +9,13 @@ instead of the unconditional "ACK" (slave.py:19-20), and the subprocess
 exit code actually propagated (the reference discards it, slave.py:32).
 
 ``fetch`` is the piece of the data plane the reference left out entirely:
-it returns the node's intermediate TSV so the master can stage it to the
+it returns the node's intermediate file so the master can stage it to the
 reduce node (SURVEY.md §3.2 "unspecified transport, missing from repo").
+Connections are persistent (docs/DATAPLANE.md): the master pipelines
+windowed fetch requests down one connection and this daemon answers them
+in order — binary frames with raw (optionally zlib) payloads when the
+request negotiates them, base64 JSON for pre-binary masters — keeping
+ONE open file handle per transfer instead of re-open+seek per chunk.
 """
 
 from __future__ import annotations
@@ -23,6 +28,8 @@ import socket
 import subprocess
 import sys
 import threading
+import time
+import zlib
 
 from locust_tpu.distributor import protocol
 from locust_tpu.utils import faultplan
@@ -50,7 +57,10 @@ def default_map_runner(req: dict) -> dict:
         "1",
         "-i",
         out,
-    ] + [str(a) for a in req.get("extra_args", [])]
+    ]
+    if req.get("inter_format"):  # packed-KV data plane (docs/DATAPLANE.md)
+        cmd += ["--inter-format", str(req["inter_format"])]
+    cmd += [str(a) for a in req.get("extra_args", [])]
     proc = subprocess.run(cmd, capture_output=True, timeout=req.get("timeout", 1800))
     return {
         "status": "ok" if proc.returncode == 0 else "error",
@@ -63,6 +73,10 @@ def default_map_runner(req: dict) -> dict:
 class Worker:
     """One worker daemon.  ``map_runner`` is injectable for loopback tests."""
 
+    # Per-connection open-handle cap: a fetch transfer needs one handle;
+    # a peer cycling paths on one connection must not leak descriptors.
+    MAX_CACHED_FILES = 8
+
     def __init__(
         self,
         host: str = "127.0.0.1",
@@ -72,11 +86,17 @@ class Worker:
         workdir: str = "/tmp",
         conn_timeout: float = 30.0,
         max_connections: int = 32,
+        support_binary: bool = True,
     ):
         if not secret:
             raise ValueError("worker requires a shared secret (Q8: no open RCE)")
         self.secret = secret
         self.map_runner = map_runner
+        # support_binary=False emulates a pre-binary (JSON-only) peer:
+        # negotiation requests are ignored and every reply is a JSON
+        # frame — the version-skew interop tests pin that an old worker
+        # and a new master still complete jobs together.
+        self.support_binary = support_binary
         # Fetch containment boundary is WORKER-side configuration; a request
         # must not be able to choose its own boundary.
         self.workdir = os.path.realpath(workdir)
@@ -127,33 +147,77 @@ class Worker:
             self._conn_slots.release()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            try:
-                # A silent peer must not hang the daemon: bound the read.
-                conn.settimeout(self.conn_timeout)
-                req = protocol.recv_frame(conn, self.secret)
-                self._replay_guard.check(req)
-                conn.settimeout(None)  # map subprocesses may run long
-                resp = self._handle(req)
-            except PermissionError:
-                return  # unauthenticated/replayed peer: drop silently
-            except faultplan.FaultCrash:
-                return  # injected 'process crash': drop the conn, no reply
-            except Exception as e:
-                # A malformed frame must never kill the daemon (that
-                # would be an unauthenticated remote DoS).
-                resp = {"status": "error", "error": str(e)}
-            try:
+        """Serve REQUESTS on this connection until the peer closes or goes
+        silent — the persistent-connection contract the master's pipelined
+        fetch rides (it keeps several chunk requests in flight; we answer
+        strictly in order, so responses need no sequence numbers).
+
+        ``files`` caches one open handle per fetched path for the
+        connection's lifetime: a windowed transfer of a multi-GB
+        intermediate costs one open(), not one per chunk.
+        """
+        files: dict[str, tuple] = {}
+        try:
+            with conn:
+                while not self._shutdown.is_set():
+                    try:
+                        # A silent peer must not hang the daemon: bound the
+                        # read.  A clean peer close lands here too (recv of
+                        # 0 bytes -> ConnectionError) — the loop exit.
+                        conn.settimeout(self.conn_timeout)
+                        req = protocol.recv_frame(conn, self.secret)
+                    except PermissionError:
+                        return  # unauthenticated/replayed peer: drop silently
+                    except (ConnectionError, socket.timeout, OSError):
+                        return  # peer closed / idled out
+                    except Exception as e:
+                        # Malformed frame: the stream cannot be resynced,
+                        # but the daemon must survive (no remote DoS) —
+                        # structured reply, then drop the connection.
+                        self._try_reply(
+                            conn, {"status": "error", "error": str(e)}
+                        )
+                        return
+                    try:
+                        self._replay_guard.check(req)
+                        conn.settimeout(None)  # map subprocesses may run long
+                        resp = self._handle(req, files)
+                    except PermissionError:
+                        return  # replayed frame: drop silently
+                    except faultplan.FaultCrash:
+                        return  # injected 'process crash': drop, no reply
+                    except Exception as e:
+                        resp = {"status": "error", "error": str(e)}
+                    if not self._try_reply(conn, resp):
+                        return
+        finally:
+            for fh, _ in files.values():
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+
+    def _try_reply(self, conn: socket.socket, resp) -> bool:
+        """Send one reply frame — JSON, or binary when the handler returned
+        a ``(meta, encoded_body, flags)`` triple.  False on a dead peer."""
+        try:
+            if isinstance(resp, tuple):
+                meta, body, flags = resp
+                protocol.send_bin_frame_encoded(
+                    conn, meta, body, self.secret, flags=flags
+                )
+            else:
                 protocol.send_frame(conn, resp, self.secret, sign_fresh=False)
-            except OSError:
-                pass
+            return True
+        except OSError:
+            return False
 
     def serve_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         return t
 
-    def _handle(self, req: dict) -> dict:
+    def _handle(self, req: dict, files: dict | None = None):
         cmd = req.get("cmd")
         if cmd not in protocol.COMMANDS:
             return {"status": "error", "error": f"unknown command {cmd!r}"}
@@ -203,9 +267,10 @@ class Worker:
                     pass
             return resp
         # fetch: stream back an intermediate file this worker produced, one
-        # bounded window per request so arbitrarily large TSVs fit the
-        # frame limit (the master loops on ``offset`` until ``eof``).
-        # Containment boundary = self.workdir (server config, NOT the request).
+        # bounded window per request so arbitrarily large intermediates fit
+        # the frame limit (the master pipelines ``offset`` windows until
+        # ``eof``).  Containment boundary = self.workdir (server config,
+        # NOT the request).
         path = req.get("path", "")
         real = os.path.realpath(path)
         if not real.startswith(self.workdir + os.sep):
@@ -219,10 +284,7 @@ class Worker:
             return {"status": "error", "error": "negative offset"}
         max_bytes = max(1, min(max_bytes, protocol.FETCH_CHUNK_MAX))
         try:
-            size = os.path.getsize(real)
-            with open(real, "rb") as f:
-                f.seek(offset)
-                data = f.read(max_bytes)
+            data, size = self._read_window(real, offset, max_bytes, files)
         except OSError as e:
             return {"status": "error", "error": str(e)}
         # eof/total reflect the REAL read (pre-fault): an injected disk-rot
@@ -234,16 +296,63 @@ class Worker:
             "io.intermediate", data,
             path=real, offset=offset, port=self.addr[1],
         )
-        return {
+        # Per-chunk digest over the RAW window: covers the wire encoding
+        # round-trip (base64 or zlib) and anything between this read and
+        # the master's disk write.
+        meta = {
             "status": "ok",
-            "data_b64": base64.b64encode(data).decode(),
-            # Per-chunk digest: covers the b64 round-trip and anything
-            # between this read and the master's disk write.
             "sha256": hashlib.sha256(data).hexdigest(),
             "offset": offset,
             "total": size,
             "eof": eof,
         }
+        if not (req.get("bin") and self.support_binary):
+            # Pre-binary master (or a worker pinned JSON-only): the
+            # original base64 JSON reply, byte for byte.
+            return dict(meta, data_b64=base64.b64encode(data).decode())
+        # Binary data plane: raw payload, zlib'd when the master accepts
+        # it and it actually shrinks the chunk.
+        flags, body, enc = 0, data, "raw"
+        if req.get("accept_zlib") and data:
+            packed = zlib.compress(data, 1)
+            if len(packed) < len(data):
+                flags, body, enc = protocol.FLAG_ZLIB, packed, "zlib"
+        # Chaos: the ENCODED payload about to be framed (docs/DATAPLANE.md).
+        # The frame MAC is computed AFTER this, so an injected corruption
+        # reaches the master as a zlib error or chunk-sha mismatch — the
+        # data-plane failure mode, distinct from rpc.frame's MAC reject.
+        rule = faultplan.fire(
+            "io.chunk", path=real, offset=offset, port=self.addr[1], enc=enc
+        )
+        if rule is not None:
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            else:
+                body = faultplan.active().mutate(rule, body)
+        return dict(meta, enc=enc, clen=len(body)), body, flags
+
+    def _read_window(
+        self, real: str, offset: int, max_bytes: int, files: dict | None
+    ) -> tuple[bytes, int]:
+        """One bounded window, through the per-connection handle cache."""
+        if files is None:  # direct _handle call (unit tests): no cache
+            with open(real, "rb") as f:
+                size = os.fstat(f.fileno()).st_size
+                f.seek(offset)
+                return f.read(max_bytes), size
+        ent = files.get(real)
+        if ent is None:
+            while len(files) >= self.MAX_CACHED_FILES:
+                _, (old, _) = files.popitem()
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            fh = open(real, "rb")
+            ent = files[real] = (fh, os.fstat(fh.fileno()).st_size)
+        fh, size = ent
+        fh.seek(offset)
+        return fh.read(max_bytes), size
 
 
 def main(argv=None) -> int:

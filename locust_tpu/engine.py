@@ -114,6 +114,14 @@ class RunResult:
         """
         return finalize_host_pairs(self.table, self.combine, sort)
 
+    def dump_intermediate(self, path: str, fmt: str = "tsv") -> None:
+        """Stage-1 output plumbing: the combined local table as an
+        intermediate file — ``tsv`` for reference parity, ``bin`` for the
+        distributor's packed-KV data plane (io/serde.py)."""
+        from locust_tpu.io import serde
+
+        serde.write_intermediate(self.to_host_pairs(), path, fmt)
+
 
 class MapReduceEngine:
     """Blocked map/shuffle/reduce on one device (mesh version in parallel/)."""

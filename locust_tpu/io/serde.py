@@ -15,14 +15,40 @@ Kept for CLI/staged-mode parity, with fixes:
 
 For TPU-shard checkpoints (stage-level resume at scale) the binary ``npz``
 format stores the packed device representation directly.
+
+The distributor's data plane (docs/DATAPLANE.md) stages intermediates in
+the packed binary KV format below instead of TSV: columnar (lens blob /
+key blob / values array) so the master decodes straight into padded key
+rows + an int32 vector with ``np.frombuffer`` — no per-line text parse —
+and so the post-combine stream compresses well on the wire (sorted keys,
+shared prefixes).  ``read_intermediate`` sniffs the magic, so mixed
+TSV/binary inputs (old workers, reference-produced files) reduce fine.
 """
 
 from __future__ import annotations
+
+import struct
 
 import numpy as np
 
 from locust_tpu.core import bytes_ops
 from locust_tpu.core.kv import KVBatch
+
+# Packed binary KV intermediate ("LKVB" v1).  Layout, all little-endian:
+#   0   4  magic b"LKVB"
+#   4   1  version (1)
+#   5   1  flags (0)
+#   6   2  reserved (0)
+#   8   4  count (u32)
+#  12   4  key-blob length (u32)
+#  16      u16[count] key lengths
+#          key blob (concatenated raw key bytes)
+#          i32[count] values
+KVB_MAGIC = b"LKVB"
+KVB_VERSION = 1
+_KVB_HEADER = struct.Struct("<4sBBHII")
+
+INTERMEDIATE_FORMATS = ("tsv", "bin")
 
 
 def write_tsv(pairs: list[tuple[bytes, int]], path: str) -> None:
@@ -30,6 +56,97 @@ def write_tsv(pairs: list[tuple[bytes, int]], path: str) -> None:
     with open(path, "wb") as f:
         for k, v in pairs:
             f.write(k + b"\t" + str(int(v)).encode() + b"\n")
+
+
+def write_kvbin(pairs: list[tuple[bytes, int]], path: str) -> None:
+    """Write live (key, value) pairs in the packed binary KV format."""
+    for k, _ in pairs:
+        if len(k) > 0xFFFF:
+            raise ValueError(
+                f"key of {len(k)} bytes exceeds the u16 length field"
+            )
+    lens = np.fromiter((len(k) for k, _ in pairs), np.uint16, len(pairs))
+    values = np.fromiter((int(v) for _, v in pairs), np.int64, len(pairs))
+    if len(values) and not (
+        values.min() >= -(2**31) and values.max() < 2**31
+    ):
+        raise OverflowError(f"value outside int32 in {path!r}")
+    blob = b"".join(k for k, _ in pairs)
+    with open(path, "wb") as f:
+        f.write(
+            _KVB_HEADER.pack(KVB_MAGIC, KVB_VERSION, 0, 0, len(pairs), len(blob))
+        )
+        f.write(lens.astype("<u2").tobytes())
+        f.write(blob)
+        f.write(values.astype("<i4").tobytes())
+
+
+def read_kvbin(path: str, key_width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Packed binary KV -> (padded key rows, int32 values).
+
+    Same output contract as ``read_tsv`` (keys truncated to ``key_width``,
+    NUL-padded uint8 rows) so the reduce stage is format-blind.  Any
+    structural inconsistency raises ValueError — a truncated or corrupted
+    file must never silently yield fewer/garbled pairs (the distributor
+    additionally sha256-verifies end to end before this runs).
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < _KVB_HEADER.size:
+        raise ValueError(f"{path!r}: truncated KVB header")
+    magic, version, _flags, _resv, count, blob_len = _KVB_HEADER.unpack(
+        data[: _KVB_HEADER.size]
+    )
+    if magic != KVB_MAGIC:
+        raise ValueError(f"{path!r}: bad KVB magic {magic!r}")
+    if version != KVB_VERSION:
+        raise ValueError(f"{path!r}: unsupported KVB version {version}")
+    want = _KVB_HEADER.size + 2 * count + blob_len + 4 * count
+    if len(data) != want:
+        raise ValueError(
+            f"{path!r}: KVB size mismatch (have {len(data)}B, header "
+            f"implies {want}B)"
+        )
+    off = _KVB_HEADER.size
+    lens = np.frombuffer(data, "<u2", count, off).astype(np.int64)
+    off += 2 * count
+    if int(lens.sum()) != blob_len:
+        raise ValueError(f"{path!r}: KVB key lengths do not sum to the blob")
+    blob = np.frombuffer(data, np.uint8, blob_len, off)
+    off += blob_len
+    values = np.frombuffer(data, "<i4", count, off).astype(np.int32)
+    rows = np.zeros((count, key_width), np.uint8)
+    if count:
+        # Vectorized scatter: byte i of the blob lands at (its key's row,
+        # its offset within the key), dropped when past key_width.
+        starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        row_of = np.repeat(np.arange(count), lens)
+        col_of = np.arange(blob_len) - np.repeat(starts, lens)
+        keep = col_of < key_width
+        rows[row_of[keep], col_of[keep]] = blob[keep]
+    return rows, values
+
+
+def is_kvbin(path: str) -> bool:
+    with open(path, "rb") as f:
+        return f.read(len(KVB_MAGIC)) == KVB_MAGIC
+
+
+def write_intermediate(
+    pairs: list[tuple[bytes, int]], path: str, fmt: str = "tsv"
+) -> None:
+    if fmt not in INTERMEDIATE_FORMATS:
+        raise ValueError(f"unknown intermediate format {fmt!r}")
+    (write_kvbin if fmt == "bin" else write_tsv)(pairs, path)
+
+
+def read_intermediate(
+    path: str, key_width: int, use_native: bool = True
+) -> tuple[np.ndarray, np.ndarray]:
+    """Format-sniffing read: packed binary KV by magic, else TSV."""
+    if is_kvbin(path):
+        return read_kvbin(path, key_width)
+    return read_tsv(path, key_width, use_native=use_native)
 
 
 def read_tsv(

@@ -30,6 +30,8 @@ a chaos plan must fail LOUDLY at parse time, not silently inject nothing):
   rpc.delay        worker before handling a cmd   ctx: cmd, shard, port
   worker.map       worker about to run a map      ctx: shard, port
   io.intermediate  worker reading a fetch chunk   ctx: path, offset, port
+  io.chunk         encoded (possibly compressed) fetch payload about to be
+                   framed (docs/DATAPLANE.md)     ctx: path, offset, port, enc
   io.checkpoint    engine snapshot just written   ctx: path
 
 Determinism: rule bookkeeping is pure counting (``after`` skips, ``times``
@@ -62,6 +64,11 @@ SITES = {
     "rpc.delay": ("delay",),
     "worker.map": ("crash", "error", "delay"),
     "io.intermediate": ("corrupt", "truncate"),
+    # The pipelined data plane's wire payload AFTER encoding (zlib or
+    # raw): corruption here reaches the master as a zlib error or a
+    # chunk-sha mismatch, not an HMAC reject — a distinct failure mode
+    # from rpc.frame, which mangles the framed wire bytes.
+    "io.chunk": ("corrupt", "truncate", "delay"),
     "io.checkpoint": ("corrupt", "truncate"),
 }
 

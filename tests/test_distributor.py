@@ -30,24 +30,25 @@ def make_inproc_runner(tmp_path):
     """Map runner that invokes the CLI in-process (fast: shared JAX runtime)."""
 
     def runner(req):
-        rc = cli.main(
-            [
-                req["file"],
-                str(req["line_start"]),
-                str(req["line_end"]),
-                str(req["node_num"]),
-                "1",
-                "-i",
-                req["intermediate"],
-                "--block-lines",
-                "8",
-                "--line-width",
-                "64",
-                "--emits-per-line",
-                "8",
-                "--no-timing",
-            ]
-        )
+        args = [
+            req["file"],
+            str(req["line_start"]),
+            str(req["line_end"]),
+            str(req["node_num"]),
+            "1",
+            "-i",
+            req["intermediate"],
+            "--block-lines",
+            "8",
+            "--line-width",
+            "64",
+            "--emits-per-line",
+            "8",
+            "--no-timing",
+        ]
+        if req.get("inter_format"):  # the master's negotiated data plane
+            args += ["--inter-format", req["inter_format"]]
+        rc = cli.main(args)
         return {"status": "ok" if rc == 0 else "error", "returncode": rc,
                 "log": "", "intermediate": req["intermediate"]}
 

@@ -69,21 +69,22 @@ def make_inproc_runner():
     """Map runner invoking the CLI in-process (fast: shared JAX runtime)."""
 
     def runner(req):
-        rc = cli.main(
-            [
-                req["file"],
-                str(req["line_start"]),
-                str(req["line_end"]),
-                str(req["node_num"]),
-                "1",
-                "-i",
-                req["intermediate"],
-                "--block-lines", "8",
-                "--line-width", "64",
-                "--emits-per-line", "8",
-                "--no-timing",
-            ]
-        )
+        args = [
+            req["file"],
+            str(req["line_start"]),
+            str(req["line_end"]),
+            str(req["node_num"]),
+            "1",
+            "-i",
+            req["intermediate"],
+            "--block-lines", "8",
+            "--line-width", "64",
+            "--emits-per-line", "8",
+            "--no-timing",
+        ]
+        if req.get("inter_format"):  # the master's negotiated data plane
+            args += ["--inter-format", req["inter_format"]]
+        rc = cli.main(args)
         return {"status": "ok" if rc == 0 else "error", "returncode": rc,
                 "log": "", "intermediate": req["intermediate"]}
 
@@ -431,6 +432,85 @@ def test_chaos_intermediate_corruption_byte_identical(corpus_file, tmp_path, cap
     assert p.rules[0].fired == 1
     outcomes = [a["outcome"] for s in res.shards for a in s.attempts]
     assert "integrity" in outcomes
+
+
+def test_chaos_compressed_chunk_corruption_byte_identical(corpus_file, tmp_path, capsysbinary):
+    """ISSUE 2 site: the ENCODED (zlib/raw) fetch payload rots after the
+    worker hashed the raw window — the master sees a zlib error or a
+    chunk-sha mismatch, the shard re-runs, output unchanged."""
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    p = plan([{"site": "io.chunk", "action": "corrupt", "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 1
+    outcomes = [a["outcome"] for s in res.shards for a in s.attempts]
+    assert "integrity" in outcomes or "error" in outcomes
+
+
+def test_chaos_chunk_truncation_byte_identical(corpus_file, tmp_path, capsysbinary):
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    p = plan([{"site": "io.chunk", "action": "truncate", "times": 1}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 1
+
+
+def test_chaos_chunk_delay_absorbed(corpus_file, tmp_path, capsysbinary):
+    """Latency at the pipelined-fetch site: a stalled chunk delays the
+    transfer but never changes the bytes."""
+    want = _fault_free(corpus_file, tmp_path, capsysbinary)
+    p = plan([{"site": "io.chunk", "action": "delay", "times": 1,
+               "delay_s": 1.0}])
+    out, res, _ = _run_wordcount(
+        corpus_file, tmp_path / "f", capsysbinary, plan=p
+    )
+    assert out == want
+    assert p.rules[0].fired == 1
+
+
+def test_chaos_persistent_chunk_corruption_structured_error(corpus_file, tmp_path):
+    """Corruption on EVERY encoded chunk: the binary data plane must turn
+    it into a structured MasterError, like the raw-window site."""
+    runner = make_inproc_runner()
+    w1 = Worker(map_runner=runner, **WORKER_KW)
+    w2 = Worker(map_runner=runner, **WORKER_KW)
+    w1.serve_in_thread()
+    w2.serve_in_thread()
+    p = plan([{"site": "io.chunk", "action": "corrupt"}])  # unlimited
+    try:
+        with faultplan.active_plan(p):
+            with pytest.raises(MasterError):
+                master.run_job(
+                    [w1.addr, w2.addr], corpus_file, SECRET,
+                    workdir=str(tmp_path / "m"),
+                    health=WorkerHealth(2, base_s=0.05, cap_s=0.5, seed=1),
+                    **JOB_KW,
+                )
+        assert p.rules[0].fired >= 1
+    finally:
+        _shutdown(w1)
+        _shutdown(w2)
+
+
+def test_dataplane_defaults_binary_packed(corpus_file, tmp_path, capsysbinary):
+    """The new data plane is the DEFAULT: fault-free jobs move packed-KV
+    intermediates over binary frames, and the per-fetch stats land in
+    JobResult.shards."""
+    from locust_tpu.io import serde
+
+    out, res, _ = _run_wordcount(corpus_file, tmp_path, capsysbinary)
+    assert all(serde.is_kvbin(p) for p in res)
+    dp = res.dataplane()
+    assert dp["binary"] and dp["fetches"] == 2 and dp["payload_bytes"] > 0
+    for s in res.shards:
+        ok = next(a for a in s.attempts if a["outcome"] == "ok")
+        f = ok["fetch"]
+        assert f["bytes"] > 0 and f["chunks"] >= 1 and f["binary"]
+        assert f["elapsed_s"] > 0 and f["wire_bytes"] > 0
 
 
 def test_chaos_everything_down_structured_error(corpus_file, tmp_path):
