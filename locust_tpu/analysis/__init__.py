@@ -1,0 +1,26 @@
+"""locust_tpu.analysis — AST-based invariant checker (tier-1 gate).
+
+Static rules for the three invariant families this repo enforces by hand
+(and has already paid debugging hours for): thread-shared state in the
+distributor, purity of traced (jit/shard_map/Pallas) code, and closed
+registries that drift silently (faultplan SITES vs docs, wire constants
+vs serde).  Lockset spirit: Savage et al., "Eraser" (1997); fault-site
+coverage spirit: Alvaro et al., lineage-driven fault injection (2015).
+
+Usage::
+
+    python -m locust_tpu.analysis [--json] [--rule R00x] [paths...]
+
+Exit code 1 on NEW findings (not in the checked-in baseline).  Rules,
+suppression syntax and the incident each rule encodes: docs/ANALYSIS.md.
+Suppress one line with ``# locust: noqa[R00x] <reason>`` — the reason is
+mandatory (an empty reason is itself a finding).
+"""
+
+from locust_tpu.analysis.core import (  # noqa: F401 - public API
+    AnalysisResult,
+    Finding,
+    SourceFile,
+    run_analysis,
+)
+from locust_tpu.analysis.registry import all_rules, get_rules  # noqa: F401

@@ -1,0 +1,89 @@
+"""CLI: ``python -m locust_tpu.analysis [--json] [--rule R00x] [paths...]``.
+
+Exit codes: 0 = no new findings (baselined findings may remain and are
+reported as such), 1 = new findings, 2 = usage/config error.  The gate
+test (tests/test_analysis.py) runs the same engine in-process; this CLI
+is the dev / CI surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from locust_tpu.analysis import config as cfg
+from locust_tpu.analysis import run_analysis
+from locust_tpu.analysis.baseline import write_baseline
+from locust_tpu.analysis.core import emit_json
+from locust_tpu.analysis.registry import all_rules
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m locust_tpu.analysis",
+        description="locust_tpu static invariant checker (docs/ANALYSIS.md)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to check (default: pyproject "
+                        "[tool.locust-analysis] paths)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--rule", action="append", default=None, metavar="R00x",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: nearest pyproject.toml)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: from pyproject)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rcls in sorted(all_rules().items()):
+            print(f"{rid}  {rcls.title}")
+        return 0
+
+    try:
+        result = run_analysis(
+            paths=args.paths or None,
+            root=args.root,
+            rules=args.rule,
+            baseline_path=args.baseline,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        root = args.root or cfg.find_root()
+        conf = cfg.load_config(root)
+        import os
+
+        path = args.baseline or os.path.join(root, conf["baseline"])
+        # R000 never enters a baseline: fix the parse error / write the
+        # noqa reason instead of accepting it.
+        n = write_baseline(
+            path, [f for f in result.findings if f.rule_id != "R000"]
+        )
+        print(f"baseline: {n} finding(s) written to {path}", file=sys.stderr)
+        return 0
+
+    if args.as_json:
+        print(emit_json(result))
+    else:
+        for f in result.findings:
+            print(f.format())
+        print(
+            f"{result.n_files} file(s), rules {','.join(result.rules)}: "
+            f"{len(result.new)} new finding(s), "
+            f"{len(result.findings) - len(result.new)} baselined, "
+            f"{result.suppressed} suppressed",
+            file=sys.stderr,
+        )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,101 @@
+"""Analyzer configuration: repo root discovery + ``[tool.locust-analysis]``.
+
+Python 3.10 has no ``tomllib``, so the pyproject section is read with a
+deliberately narrow fallback parser: our own section only, ``key = value``
+lines whose values are TOML strings/arrays-of-strings (which are also
+valid Python literals).  ``tomllib`` is used when available.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+DEFAULTS = {
+    # What the tier-1 gate sweeps.  bench.py and __graft_entry__.py are
+    # top-level driver contracts; everything else is the package + its
+    # scripts and tests.
+    "paths": [
+        "locust_tpu",
+        "scripts",
+        "tests",
+        "bench.py",
+        "__graft_entry__.py",
+    ],
+    "baseline": "analysis_baseline.json",
+}
+
+_SECTION = "tool.locust-analysis"
+
+
+def find_root(start: str | None = None) -> str:
+    """Nearest ancestor holding pyproject.toml; falls back to the repo
+    this package is installed from (two levels above this file)."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _parse_section_fallback(text: str) -> dict:
+    out: dict = {}
+    in_section = False
+    key = None
+    pending = ""  # accumulates a multi-line array value
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and pending == "":
+            in_section = line == f"[{_SECTION}]"
+            continue
+        if not in_section:
+            continue
+        if pending:
+            pending += " " + line
+        else:
+            m = re.match(r"([A-Za-z0-9_-]+)\s*=\s*(.+)$", line)
+            if not m:
+                continue
+            key, pending = m.group(1), m.group(2).strip()
+        # A value is complete when its brackets balance (handles the
+        # standard TOML multi-line array; strings here never contain
+        # brackets — ours are paths and filenames).
+        if pending.count("[") > pending.count("]"):
+            continue
+        try:
+            out[key] = ast.literal_eval(pending)
+        except (ValueError, SyntaxError):
+            pass  # a value shape we don't own; keep the default
+        pending = ""
+    return out
+
+
+def load_config(root: str) -> dict:
+    conf = dict(DEFAULTS)
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.isfile(pyproject):
+        return conf
+    with open(pyproject, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        import tomllib  # py >= 3.11
+
+        section = tomllib.loads(text).get("tool", {}).get(
+            "locust-analysis", {}
+        )
+    except ImportError:
+        section = _parse_section_fallback(text)
+    if isinstance(section.get("paths"), list):
+        conf["paths"] = [str(p) for p in section["paths"]]
+    if isinstance(section.get("baseline"), str):
+        conf["baseline"] = section["baseline"]
+    return conf

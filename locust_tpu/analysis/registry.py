@@ -1,0 +1,52 @@
+"""The closed rule registry (R001–R008) — itself anti-drift-checked:
+``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
+pins that every registered rule has firing + silent fixture coverage."""
+
+from __future__ import annotations
+
+from locust_tpu.analysis.rules_consistency import (
+    FaultSiteConsistencyRule,
+    WireConstantDriftRule,
+)
+from locust_tpu.analysis.rules_hygiene import (
+    BenchContractRule,
+    SubprocessEnvRule,
+    TrackedArtifactRule,
+)
+from locust_tpu.analysis.rules_threads import ThreadSharedStateRule
+from locust_tpu.analysis.rules_traced import (
+    HostSyncInLoopRule,
+    TracedPurityRule,
+)
+
+_RULE_CLASSES = (
+    ThreadSharedStateRule,      # R001
+    TracedPurityRule,           # R002
+    HostSyncInLoopRule,         # R003
+    FaultSiteConsistencyRule,   # R004
+    WireConstantDriftRule,      # R005
+    SubprocessEnvRule,          # R006
+    BenchContractRule,          # R007
+    TrackedArtifactRule,        # R008
+)
+
+
+def all_rules() -> dict[str, type]:
+    return {cls.rule_id: cls for cls in _RULE_CLASSES}
+
+
+def get_rules(ids=None) -> list:
+    """Instantiate the selected rules (all by default).  Unknown ids are
+    a loud error — a typo'd --rule must not silently check nothing (the
+    same closed-registry stance as faultplan.SITES)."""
+    table = all_rules()
+    if ids is None:
+        return [cls() for cls in table.values()]
+    out = []
+    for rid in ids:
+        if rid not in table:
+            raise ValueError(
+                f"unknown rule {rid!r} (known: {', '.join(sorted(table))})"
+            )
+        out.append(table[rid]())
+    return out

@@ -1,0 +1,259 @@
+"""R004/R005 — closed registries that must not drift.
+
+R004 (fault-site consistency), in the spirit of lineage-driven fault
+injection (Alvaro et al., 2015) and the two-sided CONFIG_AB_KINDS
+readers: the ``faultplan.SITES`` registry, its hook call-sites, the
+chaos suite and the docs must all agree —
+
+  * every site string at a hook call-site (``faultplan.fire("x", ...)``,
+    ``mangle``/``delay``/``damage_file``/``check_connect`` and the
+    in-module ``_PLAN.fire``) must be a registered site;
+  * every registered site must be HOOKED somewhere in ``locust_tpu/``
+    (a registry entry with no call-site injects nothing, silently);
+  * every registered site must appear in ``tests/test_faults.py`` (it is
+    exercised) and in ``docs/FAULTS.md`` (it is documented).
+
+R005 (wire-constant drift): protocol magic bytes, versions and size
+bounds have ONE defining module; a re-spelled literal elsewhere is a
+fork waiting to disagree (``MAX_FRAME`` as ``64 * 1024 * 1024``, the
+``b"\\x00LB"`` magic, serde's ``b"LKVB"``).  Constant expressions are
+folded (``core.const_int``).  Attribution discipline: magic BYTES match
+everywhere (they are distinctive), but int values match only inside the
+wire layer itself (``locust_tpu/distributor/``) — 8/32/64 MiB are round
+numbers that legitimately recur as corpus/IO sizes elsewhere, and a
+false wire-skew claim on a bench corpus size would teach people to
+ignore the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from locust_tpu.analysis.core import Finding, Rule, call_name, const_int
+
+FAULTPLAN_REL = "locust_tpu/utils/faultplan.py"
+FAULTS_TESTS_REL = "tests/test_faults.py"
+FAULTS_DOCS_REL = "docs/FAULTS.md"
+
+_HOOK_NAMES = {"fire", "mangle", "delay", "damage_file", "check_connect"}
+
+
+def _parse_sites(path: str) -> tuple[dict | None, int]:
+    """The SITES dict literal from faultplan.py: {site: line} (+ def line)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None, 0
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            sites = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    sites[k.value] = k.lineno
+            return sites, node.lineno
+    return None, 0
+
+
+class FaultSiteConsistencyRule(Rule):
+    rule_id = "R004"
+    title = "faultplan SITES registry drift"
+
+    # Overridable for fixture trees in tests.
+    faultplan_rel = FAULTPLAN_REL
+    tests_rel = FAULTS_TESTS_REL
+    docs_rel = FAULTS_DOCS_REL
+
+    def check_project(self, files, root):
+        fp_path = os.path.join(root, self.faultplan_rel)
+        sites, sites_line = _parse_sites(fp_path)
+        if sites is None:
+            yield Finding(
+                self.rule_id, self.faultplan_rel, 1, 0,
+                "cannot parse the SITES registry (module missing or no "
+                "module-level `SITES = {...}` dict literal)",
+            )
+            return
+
+        # Side 1: hook call-site strings must be registered sites.
+        hooked: set[str] = set()
+        for sf in files:
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                func = node.func
+                attr = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else None
+                )
+                if attr not in _HOOK_NAMES:
+                    continue
+                arg0 = node.args[0]
+                if not (
+                    isinstance(arg0, ast.Constant)
+                    and isinstance(arg0.value, str)
+                ):
+                    continue
+                site = arg0.value
+                if "." not in site:  # e.g. str.replace("a", ...) lookalikes
+                    continue
+                if site not in sites:
+                    yield Finding(
+                        self.rule_id, sf.rel, node.lineno, node.col_offset,
+                        f"fault hook {call_name(node)}({site!r}, ...) uses "
+                        "a site not in faultplan.SITES — a typo'd site "
+                        "injects nothing, silently",
+                    )
+                elif sf.rel.split("/", 1)[0] == "locust_tpu":
+                    hooked.add(site)
+
+        # check_connect hardcodes rpc.connect inside faultplan itself;
+        # callers of check_connect(host, port) exercise it without the
+        # string, so count the site hooked if ANY call-site exists.
+        if "rpc.connect" in sites and any(
+            isinstance(node, ast.Call)
+            and call_name(node).endswith("check_connect")
+            for sf in files
+            if sf.rel.split("/", 1)[0] == "locust_tpu"
+            for node in ast.walk(sf.tree)
+        ):
+            hooked.add("rpc.connect")
+
+        def read(rel):
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                return None
+
+        tests_text = read(self.tests_rel)
+        docs_text = read(self.docs_rel)
+
+        # Side 2: every registered site is hooked, tested, documented.
+        for site, line in sorted(sites.items()):
+            if site not in hooked:
+                yield Finding(
+                    self.rule_id, self.faultplan_rel, line, 0,
+                    f"SITES entry {site!r} has no hook call-site under "
+                    "locust_tpu/ — a registered site that injects nothing",
+                )
+            if tests_text is None:
+                yield Finding(
+                    self.rule_id, self.tests_rel, 1, 0,
+                    f"chaos suite {self.tests_rel} missing — SITES "
+                    "entries cannot be verified as exercised",
+                )
+                tests_text = ""  # report the missing file once
+            elif site not in tests_text:
+                yield Finding(
+                    self.rule_id, self.faultplan_rel, line, 0,
+                    f"SITES entry {site!r} is never exercised in "
+                    f"{self.tests_rel} — an untested fault site is an "
+                    "untested recovery path",
+                )
+            if docs_text is None:
+                yield Finding(
+                    self.rule_id, self.docs_rel, 1, 0,
+                    f"fault docs {self.docs_rel} missing — SITES entries "
+                    "cannot be verified as documented",
+                )
+                docs_text = ""
+            elif site not in docs_text:
+                yield Finding(
+                    self.rule_id, self.faultplan_rel, line, 0,
+                    f"SITES entry {site!r} is undocumented in "
+                    f"{self.docs_rel}",
+                )
+
+
+# name -> defining module (repo-relative).  Ints below _INT_FLOOR are too
+# common to attribute; bytes magics always match exactly.
+WIRE_CONSTANTS = {
+    "MAX_FRAME": "locust_tpu/distributor/protocol.py",
+    "FETCH_CHUNK": "locust_tpu/distributor/protocol.py",
+    "FETCH_CHUNK_MAX": "locust_tpu/distributor/protocol.py",
+    "BIN_MAGIC": "locust_tpu/distributor/protocol.py",
+    "BIN_VERSION": "locust_tpu/distributor/protocol.py",
+    "KVB_MAGIC": "locust_tpu/io/serde.py",
+    "KVB_VERSION": "locust_tpu/io/serde.py",
+}
+_INT_FLOOR = 65536
+
+
+def _defined_constants(root: str) -> dict:
+    """{name: (value, definer_rel)} for each wire constant we can read."""
+    out = {}
+    for name, rel in WIRE_CONSTANTS.items():
+        path = os.path.join(root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            ):
+                continue
+            if (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, bytes)
+            ):
+                out[name] = (node.value.value, rel)
+            else:
+                iv = const_int(node.value)
+                if iv is not None:
+                    out[name] = (iv, rel)
+    return out
+
+
+class WireConstantDriftRule(Rule):
+    rule_id = "R005"
+    title = "re-spelled wire constant"
+
+    def check_project(self, files, root):
+        consts = _defined_constants(root)
+        by_bytes = {
+            v: (n, rel) for n, (v, rel) in consts.items()
+            if isinstance(v, bytes)
+        }
+        by_int = {
+            v: (n, rel) for n, (v, rel) in consts.items()
+            if isinstance(v, int) and v >= _INT_FLOOR
+        }
+        for sf in files:
+            in_wire_layer = sf.rel.startswith("locust_tpu/distributor/")
+            for node in ast.walk(sf.tree):
+                hit = None
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, bytes
+                ):
+                    hit = by_bytes.get(node.value)
+                elif in_wire_layer and isinstance(
+                    node, (ast.Constant, ast.BinOp)
+                ):
+                    iv = const_int(node)
+                    if iv is not None:
+                        hit = by_int.get(iv)
+                # A definer may spell ITS OWN constants — but not another
+                # module's (protocol.py re-spelling serde's KVB_MAGIC is
+                # exactly the cross-module skew this rule exists for).
+                if hit is None or hit[1] == sf.rel:
+                    continue
+                name, definer = hit
+                yield Finding(
+                    self.rule_id, sf.rel, node.lineno, node.col_offset,
+                    f"literal re-spells {name} (defined once in "
+                    f"{definer}) — import it; a fork of a wire constant "
+                    "is a protocol skew waiting to disagree",
+                )

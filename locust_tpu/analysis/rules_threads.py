@@ -1,0 +1,190 @@
+"""R001 — thread-shared state written without a lock (lockset heuristic).
+
+The incident: the distributor's attempt/fetch/heartbeat threads (PR 1/2)
+were hardened against "abandoned-loser pool-shutdown races" by code
+review, not by tooling.  This rule is the Eraser-style (Savage et al.,
+1997) static shadow of that review: a function that RUNS ON A THREAD
+(``threading.Thread(target=...)``, ``executor.submit(fn)``,
+``executor.map(fn)``) must not write ``self.*`` attributes, ``global``
+names, or ``nonlocal`` closure slots outside a ``with <lock>:`` block.
+
+Heuristics (documented in docs/ANALYSIS.md):
+
+  * entry points are resolved BY NAME within the module (callees of the
+    thread entry are not followed — no interprocedural call graph);
+  * "a lock" is any ``with`` context whose expression mentions
+    lock/mutex/semaphore/cond (``with self._lock:`` etc.);
+  * local variables and attribute writes on non-``self`` locals are NOT
+    flagged (per-shard locals like ``stats.winner`` are thread-private
+    by construction in this codebase; flagging them would bury the
+    signal).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from locust_tpu.analysis.core import Finding, Rule, call_name, unparse
+
+_LOCKISH = ("lock", "mutex", "semaphore", "cond")
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    src = unparse(item.context_expr).lower()
+    return any(word in src for word in _LOCKISH)
+
+
+def _executor_names(fn: ast.AST) -> set[str]:
+    """Names bound to ThreadPoolExecutor-ish constructors in this scope
+    (``with ThreadPoolExecutor(...) as ex`` / ``pool = ...Executor(...)``)."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.withitem):
+            ctx, opt = node.context_expr, node.optional_vars
+            if (
+                isinstance(ctx, ast.Call)
+                and "Executor" in call_name(ctx)
+                and isinstance(opt, ast.Name)
+            ):
+                names.add(opt.id)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if "Executor" in call_name(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _entry_refs(tree: ast.Module):
+    """(expr, how) for every function reference handed to a thread."""
+    executors = _executor_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = call_name(node)
+        if callee.split(".")[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    yield kw.value, "threading.Thread target"
+        elif isinstance(node.func, ast.Attribute):
+            owner = node.func.value
+            owner_name = owner.id if isinstance(owner, ast.Name) else None
+            if node.func.attr == "submit" and node.args:
+                yield node.args[0], "executor.submit callable"
+            elif (
+                node.func.attr == "map"
+                and node.args
+                and owner_name in executors
+            ):
+                yield node.args[0], "executor.map callable"
+
+
+def _resolve(ref: ast.AST, by_name: dict):
+    """Thread-entry reference -> function nodes (best-effort, by name)."""
+    if isinstance(ref, ast.Lambda):
+        return [ref]
+    if isinstance(ref, ast.Name):
+        return by_name.get(ref.id, [])
+    if isinstance(ref, ast.Attribute):  # self.method / obj.method
+        return by_name.get(ref.attr, [])
+    return []
+
+
+class _WriteScanner:
+    """Walk a thread-entry body tracking lock context; collect unlocked
+    writes to self.*/global/nonlocal state."""
+
+    def __init__(self, shared_names: set[str]):
+        self.shared = shared_names  # global/nonlocal-declared in this fn
+        self.hits: list[tuple[ast.AST, str]] = []
+
+    def scan(self, node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = locked or any(_is_lock_ctx(i) for i in node.items)
+            for child in ast.iter_child_nodes(node):
+                self.scan(child, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not locked:
+                for t in targets:
+                    desc = self._shared_target(t)
+                    if desc:
+                        self.hits.append((node, desc))
+        for child in ast.iter_child_nodes(node):
+            self.scan(child, locked)
+
+    def _shared_target(self, t: ast.AST) -> str | None:
+        root = t
+        while isinstance(root, ast.Subscript):
+            root = root.value
+        if isinstance(root, ast.Attribute):
+            base = root.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return f"self.{root.attr}"
+        if isinstance(root, ast.Name) and root.id in self.shared:
+            return root.id
+        return None
+
+
+def _declared_shared(fn: ast.AST) -> set[str]:
+    """Names this entry function shares across threads: ``global``
+    anywhere in its subtree, but ``nonlocal`` only when DECLARED BY the
+    entry function itself — a nested def's nonlocal refers to the entry
+    function's own locals, which are private to its thread."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+
+    def own_statements(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from own_statements(child)
+
+    for node in own_statements(fn):
+        if isinstance(node, ast.Nonlocal):
+            names.update(node.names)
+    return names
+
+
+class ThreadSharedStateRule(Rule):
+    rule_id = "R001"
+    title = "thread-shared state written without a lock"
+
+    def check_file(self, f, root):
+        tree = f.tree
+        by_name: dict[str, list] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        seen: set[int] = set()
+        for ref, how in _entry_refs(tree):
+            for fn in _resolve(ref, by_name):
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                shared = _declared_shared(fn)
+                scanner = _WriteScanner(shared)
+                body = fn.body if hasattr(fn, "body") else [fn]
+                for stmt in body if isinstance(body, list) else [body]:
+                    scanner.scan(stmt, locked=False)
+                name = getattr(fn, "name", "<lambda>")
+                for node, desc in scanner.hits:
+                    yield Finding(
+                        self.rule_id,
+                        f.rel,
+                        node.lineno,
+                        node.col_offset,
+                        f"'{name}' runs on a thread ({how}) and writes "
+                        f"shared state {desc} with no enclosing "
+                        "'with <lock>:' — a data race heuristic; guard it "
+                        "or noqa with the synchronization argument",
+                    )

@@ -1,0 +1,156 @@
+"""R002/R003 — purity and host-sync discipline around traced code.
+
+R002 (traced-purity): functions handed to ``jax.jit`` / ``shard_map`` /
+``compat_shard_map`` / ``pallas_call`` (as calls or decorators) run under
+tracing: side effects execute ONCE at trace time and then silently never
+again — or, for Pallas interpret mode on CPU, can crash the XLA compiler
+outright (the bitonic-under-mesh segfault guard, CLAUDE.md).  Flags
+``print``, ``time.*``, ``random.*``/``np.random.*``, ``open``/socket
+I/O, and global/nonlocal writes inside the traced function's subtree.
+``jax.debug.print`` / ``pl.debug_print`` are the sanctioned forms and
+stay silent.
+
+R003 (host-sync-in-hot-loop): ``block_until_ready``/``jax.device_get``
+inside a ``for``/``while`` loop in library code serializes the device
+pipeline per iteration — the exact anti-pattern the fused ``lax.scan``
+engine exists to avoid.  Deliberate syncs (stage-timing boundaries,
+bounded-inflight backpressure) carry a noqa with their argument.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from locust_tpu.analysis.core import Finding, Rule, call_name
+
+_TRACER_RE = re.compile(
+    r"(^|\.)(jit|shard_map|compat_shard_map|pallas_call)$"
+)
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "socket.", "os.environ")
+_SANCTIONED = ("debug.print", "debug_print")
+
+
+def _traced_fn_exprs(tree: ast.Module):
+    """Expressions positioned as the to-be-traced function: first arg of
+    tracer calls (unwrapping nested tracer calls, e.g.
+    ``jax.jit(compat_shard_map(body, ...))``), plus decorated defs."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _TRACER_RE.search(call_name(node)):
+            if node.args:
+                arg = node.args[0]
+                while (
+                    isinstance(arg, ast.Call)
+                    and _TRACER_RE.search(call_name(arg))
+                    and arg.args
+                ):
+                    arg = arg.args[0]
+                yield arg
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                # Unparse the WHOLE decorator: for the dominant
+                # @functools.partial(jax.jit, static_argnames=...) idiom
+                # the tracer name lives in the call's ARGUMENTS, which
+                # call_name() would drop.
+                src = ast.unparse(dec)
+                if _TRACER_RE.search(src) or re.search(
+                    r"\b(jit|shard_map|pallas_call)\b", src
+                ):
+                    yield node
+                    break
+
+
+def _impurities(fn: ast.AST):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = call_name(node)
+            if callee == "print":
+                yield node, "print() call"
+            elif callee == "open":
+                yield node, "file I/O (open)"
+            elif any(callee.startswith(p) for p in _IMPURE_PREFIXES):
+                if not callee.endswith(_SANCTIONED):
+                    yield node, f"host side effect ({callee})"
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield node, f"{kind} write ({', '.join(node.names)})"
+
+
+class TracedPurityRule(Rule):
+    rule_id = "R002"
+    title = "impure statement inside jit/shard_map/pallas-traced code"
+
+    def check_file(self, f, root):
+        by_name: dict[str, list] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        seen: set[int] = set()
+        for expr in _traced_fn_exprs(f.tree):
+            if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                fns = [expr]
+            elif isinstance(expr, ast.Name):
+                fns = by_name.get(expr.id, [])
+            elif isinstance(expr, ast.Attribute):
+                fns = by_name.get(expr.attr, [])
+            else:
+                fns = []
+            for fn in fns:
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                name = getattr(fn, "name", "<lambda>")
+                for node, what in _impurities(fn):
+                    yield Finding(
+                        self.rule_id,
+                        f.rel,
+                        node.lineno,
+                        node.col_offset,
+                        f"{what} inside traced function '{name}': runs "
+                        "once at trace time, then never again (or crashes "
+                        "the compiler in Pallas interpret mode) — hoist it "
+                        "out of the traced body",
+                    )
+
+
+_SYNC_ATTRS = {"block_until_ready"}
+_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get"}
+
+
+class HostSyncInLoopRule(Rule):
+    rule_id = "R003"
+    title = "host sync inside a hot loop"
+
+    def check_file(self, f, root):
+        # Library code only: tests and scripts sync at will.
+        top = f.rel.split("/", 1)[0]
+        if top != "locust_tpu":
+            return
+        if "import jax" not in f.text:
+            return
+        seen: set[int] = set()  # nested loops: report each sync once
+        for loop in ast.walk(f.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                callee = call_name(node)
+                is_sync = callee in _SYNC_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                )
+                if is_sync:
+                    yield Finding(
+                        self.rule_id,
+                        f.rel,
+                        node.lineno,
+                        node.col_offset,
+                        f"host sync ({callee}) inside a loop serializes "
+                        "the device pipeline per iteration — batch the "
+                        "loop into one dispatch (lax.scan) or noqa with "
+                        "the backpressure/timing argument",
+                    )

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""One-shot dev gate: static analysis + its test suite.
+
+    env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
+
+Runs (1) the invariant checker over the configured paths (exit 1 on new
+findings — docs/ANALYSIS.md) and (2) tests/test_analysis.py, which
+includes the repo-wide gate test.  ``--fast`` skips the pytest half.
+Exit code is non-zero if either half fails.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    fast = "--fast" in argv
+
+    # In-process: the analyzer imports no checked code (and no jax).
+    sys.path.insert(0, REPO)
+    from locust_tpu.analysis import run_analysis
+
+    result = run_analysis(root=REPO)
+    for f in result.findings:
+        print(f.format(), file=sys.stderr)
+    print(
+        f"[check] analysis: {len(result.new)} new finding(s) over "
+        f"{result.n_files} file(s), {result.suppressed} suppressed",
+        file=sys.stderr,
+    )
+    rc = 1 if result.new else 0
+    if fast:
+        return rc
+
+    # Pinned env (R006 applies to this script too): the analyzer suite
+    # runs pytest in a child python; the child must not be hangable by
+    # the ambient axon sitecustomize.
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_analysis.py", "-q"],
+        cwd=REPO, env=env, timeout=600,
+    )
+    print(
+        f"[check] tests: rc={proc.returncode}; analysis rc={rc}",
+        file=sys.stderr,
+    )
+    return rc or proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -115,7 +115,7 @@ def probe() -> bool:
     """Subprocess-isolated tunnel probe: a wedged tunnel hangs any python
     that touches a jax backend (CLAUDE.md), so the probe must be killable."""
     try:
-        r = subprocess.run(
+        r = subprocess.run(  # locust: noqa[R006] the probe must see the ambient axon plugin — pinning the env away would probe nothing; timeout=150 bounds a wedged tunnel
             [sys.executable, "-c",
              "from locust_tpu.backend import probe_tpu;"
              "ok, d = probe_tpu(timeout_s=90, retries=1);"

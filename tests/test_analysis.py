@@ -1,0 +1,693 @@
+"""locust_tpu.analysis — fixture tests per rule + the repo-wide gate.
+
+Layout: each rule gets at least one FIRING fixture and one SILENT
+fixture (the rule catalog's contract, docs/ANALYSIS.md); R004/R005 are
+additionally demonstrated by MUTATING copies of the real modules
+(faultplan SITES, protocol constants) so registry drift provably fails
+the gate.  ``test_repo_gate`` then runs the whole rule set over the
+actual tree — that test IS the tier-1 wiring: no new CI infrastructure,
+a finding anywhere in locust_tpu/, scripts/ or tests/ fails the suite.
+
+Pure host-side AST work: no jax import, no device, fast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from locust_tpu.analysis import run_analysis
+from locust_tpu.analysis.baseline import write_baseline
+from locust_tpu.analysis.registry import all_rules, get_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, rel, code):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return path
+
+
+def _run(root, rules, paths=None):
+    return run_analysis(
+        paths=paths, root=str(root), rules=rules,
+        baseline_path=str(root / "no_baseline.json"),
+    )
+
+
+def _ids(result):
+    return [(f.rule_id, f.path) for f in result.new]
+
+
+# ------------------------------------------------------------------- R001
+
+
+def test_r001_fires_on_unlocked_self_write_in_thread_target(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Srv:
+            def start(self):
+                threading.Thread(target=self.worker, daemon=True).start()
+
+            def worker(self):
+                self.state = "running"
+    """)
+    res = _run(tmp_path, ["R001"], ["mod.py"])
+    assert len(res.new) == 1
+    assert "self.state" in res.new[0].message
+
+
+def test_r001_fires_on_global_write_via_executor_submit(tmp_path):
+    _write(tmp_path, "mod.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        total = 0
+
+        def task():
+            global total
+            total += 1
+
+        def run():
+            with ThreadPoolExecutor() as ex:
+                ex.submit(task)
+    """)
+    res = _run(tmp_path, ["R001"], ["mod.py"])
+    assert len(res.new) == 1
+    assert "total" in res.new[0].message
+
+
+def test_r001_silent_when_write_is_under_lock(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self.worker).start()
+
+            def worker(self):
+                with self._lock:
+                    self.state = "running"
+    """)
+    assert not _run(tmp_path, ["R001"], ["mod.py"]).new
+
+
+def test_r001_silent_on_entry_fn_own_locals_and_nested_nonlocals(tmp_path):
+    # master.py's shape: the entry fn's own locals, mutated via a nested
+    # helper's nonlocal, are private to the entry thread — not shared.
+    _write(tmp_path, "mod.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(shard):
+            seq = 0
+
+            def launch():
+                nonlocal seq
+                seq += 1
+
+            launch()
+            return seq
+
+        def run(n):
+            with ThreadPoolExecutor() as ex:
+                return list(ex.map(one, range(n)))
+    """)
+    assert not _run(tmp_path, ["R001"], ["mod.py"]).new
+
+
+# ------------------------------------------------------------------- R002
+
+
+def test_r002_fires_on_print_and_time_in_jitted_fn(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import time
+        import jax
+
+        def step(x):
+            print("tracing", x)
+            t = time.time()
+            return x * t
+
+        step_j = jax.jit(step)
+    """)
+    res = _run(tmp_path, ["R002"], ["mod.py"])
+    messages = " | ".join(f.message for f in res.new)
+    assert len(res.new) == 2
+    assert "print()" in messages and "time.time" in messages
+
+
+def test_r002_fires_on_global_write_in_shard_map_body(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import jax
+        from locust_tpu.parallel.mesh import compat_shard_map
+
+        calls = 0
+
+        def body(x):
+            global calls
+            calls += 1
+            return x
+
+        step = jax.jit(compat_shard_map(body, None, None, None))
+    """)
+    res = _run(tmp_path, ["R002"], ["mod.py"])
+    assert len(res.new) == 1
+    assert "global write" in res.new[0].message
+
+
+def test_r002_fires_under_functools_partial_jit_decorator(tmp_path):
+    # The dominant decorator idiom in this repo (radix_sort, tokenize,
+    # pagerank): the tracer name lives in the partial's ARGUMENTS.
+    _write(tmp_path, "mod.py", """
+        import functools
+        import time
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            t = time.time()
+            return x * n * t
+    """)
+    res = _run(tmp_path, ["R002"], ["mod.py"])
+    assert len(res.new) == 1
+    assert "time.time" in res.new[0].message
+
+
+def test_r002_silent_on_pure_fn_and_sanctioned_debug_print(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            jax.debug.print("x = {}", x)
+            return x * n
+    """)
+    assert not _run(tmp_path, ["R002"], ["mod.py"]).new
+
+
+# ------------------------------------------------------------------- R003
+
+
+def test_r003_fires_on_sync_in_loop(tmp_path):
+    _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            out = []
+            for b in blocks:
+                out.append(jax.block_until_ready(b))
+            return out
+    """)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "block_until_ready" in res.new[0].message
+
+
+def test_r003_silent_outside_loops_and_outside_library(tmp_path):
+    _write(tmp_path, "locust_tpu/ok.py", """
+        import jax
+
+        def run(x):
+            y = step(x)
+            jax.block_until_ready(y)
+            return y
+    """)
+    _write(tmp_path, "scripts/tool.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)
+    """)
+    assert not _run(tmp_path, ["R003"], ["locust_tpu", "scripts"]).new
+
+
+# ------------------------------------------------------------------- R004
+
+_FIXTURE_FAULTPLAN = """
+    SITES = {
+        "rpc.ping": ("delay",),
+        "io.write": ("corrupt",),
+    }
+"""
+
+
+def _r004_tree(tmp_path, hook_site="rpc.ping", tests_text=None,
+               docs_text=None, faultplan=_FIXTURE_FAULTPLAN):
+    _write(tmp_path, "locust_tpu/utils/faultplan.py", faultplan)
+    _write(tmp_path, "locust_tpu/net.py", f"""
+        from locust_tpu.utils import faultplan
+
+        def send(data):
+            faultplan.delay({hook_site!r}, cmd="send")
+            faultplan.mangle("io.write", data)
+            return data
+    """)
+    _write(tmp_path, "tests/test_faults.py",
+           tests_text if tests_text is not None
+           else '# exercises "rpc.ping" and "io.write"\n')
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "FAULTS.md").write_text(
+        docs_text if docs_text is not None
+        else "| `rpc.ping` | ... |\n| `io.write` | ... |\n"
+    )
+
+
+def test_r004_silent_when_registry_call_sites_tests_docs_agree(tmp_path):
+    _r004_tree(tmp_path)
+    assert not _run(tmp_path, ["R004"], ["locust_tpu", "tests"]).new
+
+
+def test_r004_fires_on_typod_call_site(tmp_path):
+    _r004_tree(tmp_path, hook_site="rpc.pnig")
+    res = _run(tmp_path, ["R004"], ["locust_tpu", "tests"])
+    assert any("rpc.pnig" in f.message and "not in faultplan.SITES"
+               in f.message for f in res.new)
+
+
+def test_r004_fires_on_unexercised_and_undocumented_site(tmp_path):
+    _r004_tree(tmp_path, tests_text='# only "rpc.ping" here\n',
+               docs_text="| `rpc.ping` |\n")
+    res = _run(tmp_path, ["R004"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "never exercised" in msgs and "undocumented" in msgs
+    assert all("io.write" in f.message for f in res.new)
+
+
+def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
+    """The acceptance demo: copy the REAL faultplan + hook modules +
+    chaos suite + docs, add one site to SITES — the gate must fail with
+    unhooked/untested/undocumented findings for exactly that site."""
+    for rel in (
+        "locust_tpu/utils/faultplan.py",
+        "locust_tpu/distributor/protocol.py",
+        "locust_tpu/distributor/worker.py",
+        "locust_tpu/distributor/master.py",
+        "locust_tpu/parallel/shuffle.py",
+        "tests/test_faults.py",
+        "docs/FAULTS.md",
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    paths = ["locust_tpu", "tests"]
+    assert not _run(tmp_path, ["R004"], paths).new  # faithful copy: green
+
+    fp = tmp_path / "locust_tpu/utils/faultplan.py"
+    mutated = fp.read_text().replace(
+        'SITES = {', 'SITES = {\n    "io.phantom": ("corrupt",),', 1
+    )
+    assert 'io.phantom' in mutated
+    fp.write_text(mutated)
+    res = _run(tmp_path, ["R004"], paths)
+    assert len(res.new) == 3  # unhooked + untested + undocumented
+    assert all("io.phantom" in f.message for f in res.new)
+
+
+# ------------------------------------------------------------------- R005
+
+
+def test_r005_fires_on_respelled_max_frame_in_wire_layer(tmp_path):
+    shutil.copy(
+        os.path.join(REPO, "locust_tpu/distributor/protocol.py"),
+        _write(tmp_path, "locust_tpu/distributor/protocol.py", ""),
+    )
+    _write(tmp_path, "locust_tpu/distributor/evil.py", """
+        LIMIT = 64 * 1024 * 1024  # forked spelling of MAX_FRAME
+    """)
+    res = _run(tmp_path, ["R005"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "MAX_FRAME" in res.new[0].message
+
+
+def test_r005_fires_on_respelled_magic_bytes_anywhere(tmp_path):
+    shutil.copy(
+        os.path.join(REPO, "locust_tpu/distributor/protocol.py"),
+        _write(tmp_path, "locust_tpu/distributor/protocol.py", ""),
+    )
+    _write(tmp_path, "scripts/sniff.py", """
+        def is_binary(frame: bytes) -> bool:
+            return frame.startswith(b"\\x00LB")
+    """)
+    res = _run(tmp_path, ["R005"], ["locust_tpu", "scripts"])
+    assert len(res.new) == 1
+    assert "BIN_MAGIC" in res.new[0].message
+
+
+def test_r005_one_definer_respelling_anothers_magic_fires(tmp_path):
+    # The definer exemption is PER-CONSTANT: serde may spell b"LKVB" but
+    # not protocol's b"\x00LB" — cross-module skew between the two wire
+    # modules is the likeliest fork of all.
+    for rel in ("locust_tpu/distributor/protocol.py",
+                "locust_tpu/io/serde.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    assert not _run(tmp_path, ["R005"], ["locust_tpu"]).new  # faithful: green
+    serde = tmp_path / "locust_tpu/io/serde.py"
+    serde.write_text(
+        serde.read_text()
+        + '\n\ndef _sniff(frame):\n    return frame[:3] == b"\\x00LB"\n'
+    )
+    res = _run(tmp_path, ["R005"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "BIN_MAGIC" in res.new[0].message
+
+
+def test_r005_silent_on_imported_constant_and_out_of_layer_sizes(tmp_path):
+    shutil.copy(
+        os.path.join(REPO, "locust_tpu/distributor/protocol.py"),
+        _write(tmp_path, "locust_tpu/distributor/protocol.py", ""),
+    )
+    _write(tmp_path, "locust_tpu/distributor/good.py", """
+        from locust_tpu.distributor import protocol
+
+        def cap(n):
+            return min(n, protocol.MAX_FRAME)
+    """)
+    # 64 MiB as a CORPUS size outside the wire layer: legitimate.
+    _write(tmp_path, "scripts/bench_thing.py", """
+        TARGET_BYTES = 64 * 1024 * 1024
+    """)
+    assert not _run(tmp_path, ["R005"], ["locust_tpu", "scripts"]).new
+
+
+# ------------------------------------------------------------------- R006
+
+
+def test_r006_fires_on_unpinned_python_spawn(tmp_path):
+    _write(tmp_path, "tests/test_x.py", """
+        import subprocess
+        import sys
+
+        def test_child():
+            subprocess.run([sys.executable, "-c", "print(1)"], timeout=5)
+    """)
+    res = _run(tmp_path, ["R006"], ["tests"])
+    assert len(res.new) == 1
+    assert "inherited environment" in res.new[0].message
+
+
+def test_r006_fires_when_env_lacks_the_pins(tmp_path):
+    _write(tmp_path, "scripts/go.py", """
+        import os
+        import subprocess
+        import sys
+
+        def launch():
+            env = dict(os.environ)
+            env["OTHER"] = "1"
+            subprocess.run([sys.executable, "x.py"], env=env)
+    """)
+    res = _run(tmp_path, ["R006"], ["scripts"])
+    assert len(res.new) == 1
+    assert "JAX_PLATFORMS" in res.new[0].message
+
+
+def test_r006_silent_on_pinned_env_wrapper_param_and_non_python(tmp_path):
+    _write(tmp_path, "tests/test_ok.py", """
+        import os
+        import subprocess
+        import sys
+
+        def test_pinned(repo):
+            env = dict(os.environ)
+            env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+            subprocess.run([sys.executable, "-c", "pass"], env=env)
+
+        def run_phase(cmd, env):
+            # wrapper: callers own the pinning
+            subprocess.run([sys.executable, *cmd], env=env)
+
+        def test_git():
+            subprocess.run(["git", "status"])
+    """)
+    assert not _run(tmp_path, ["R006"], ["tests"]).new
+
+
+# ------------------------------------------------------------------- R007
+
+
+def test_r007_fires_on_stray_stdout_print_and_double_emit(tmp_path):
+    _write(tmp_path, "bench.py", """
+        import json
+
+        def main():
+            print("starting up")
+            print(json.dumps({"metric": "x"}))
+            print(json.dumps({"metric": "again"}))
+    """)
+    res = _run(tmp_path, ["R007"], ["bench.py"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "outside the one-JSON-line contract" in msgs
+    assert "exactly ONE print(json.dumps" in msgs
+
+
+def test_r007_fires_on_flushed_literal_noise(tmp_path):
+    # flush=True is not a free pass: a relay must print a CAPTURED value
+    # (Name/Subscript), not a literal that adds a second stdout line.
+    _write(tmp_path, "bench.py", """
+        import json
+
+        def main():
+            print("sneaky stdout noise", flush=True)
+            print(json.dumps({"metric": "x"}), flush=True)
+    """)
+    res = _run(tmp_path, ["R007"], ["bench.py"])
+    assert len(res.new) == 1
+    assert "outside the one-JSON-line contract" in res.new[0].message
+
+
+def test_r007_silent_on_contract_shape(tmp_path):
+    _write(tmp_path, "bench.py", """
+        import json
+        import sys
+
+        def emit(payload):
+            print(json.dumps(payload), flush=True)
+
+        def main():
+            print("[bench] progress", file=sys.stderr)
+            line = '{"metric": 1}'
+            print(line, flush=True)  # relay of a child's captured line
+    """)
+    assert not _run(tmp_path, ["R007"], ["bench.py"]).new
+
+
+# ------------------------------------------------------------------- R008
+
+
+def test_r008_tracked_junk_regex():
+    from locust_tpu.analysis.rules_hygiene import _TRACKED_JUNK
+
+    assert _TRACKED_JUNK.search("locust_tpu/__pycache__/engine.cpython-310.pyc")
+    assert _TRACKED_JUNK.search("a/b/__pycache__/x.pyc")
+    assert _TRACKED_JUNK.search("x/.pytest_cache/v/cache")
+    assert _TRACKED_JUNK.search("mod.pyc")
+    assert not _TRACKED_JUNK.search("locust_tpu/engine.py")
+    assert not _TRACKED_JUNK.search("docs/cache_notes.md")
+
+
+def test_r008_repo_has_no_tracked_artifacts_and_gitignore_covers():
+    res = run_analysis(root=REPO, rules=["R008"])
+    assert not res.new, [f.format() for f in res.new]
+
+
+# --------------------------------------------------------- noqa + baseline
+
+
+def test_noqa_with_reason_suppresses(tmp_path):
+    _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)  # locust: noqa[R003] backpressure: bounded queue depth
+    """)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert not res.new and res.suppressed == 1
+
+
+def test_noqa_without_reason_does_not_suppress_and_flags_itself(tmp_path):
+    _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)  # locust: noqa[R003]
+    """)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    ids = sorted(f.rule_id for f in res.new)
+    assert ids == ["R000", "R003"]
+    assert "no reason" in next(
+        f.message for f in res.new if f.rule_id == "R000"
+    )
+
+
+def test_noqa_for_a_different_rule_does_not_suppress(tmp_path):
+    _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)  # locust: noqa[R005] wrong rule id
+    """)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert [f.rule_id for f in res.new] == ["R003"]
+
+
+def test_baseline_roundtrip_suppresses_then_burns_down(tmp_path):
+    src = _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)
+    """)
+    baseline = tmp_path / "baseline.json"
+    res = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                       rules=["R003"], baseline_path=str(baseline))
+    assert len(res.new) == 1
+    write_baseline(str(baseline), res.findings)
+
+    res2 = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                        rules=["R003"], baseline_path=str(baseline))
+    assert not res2.new
+    assert len(res2.findings) == 1 and res2.findings[0].baselined
+
+    # Fixing the finding leaves a stale baseline entry, not a failure.
+    src.write_text("import jax\n")
+    res3 = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                        rules=["R003"], baseline_path=str(baseline))
+    assert not res3.findings
+
+
+def test_baseline_survives_unrelated_line_drift(tmp_path):
+    code = """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)
+    """
+    src = _write(tmp_path, "locust_tpu/hot.py", code)
+    baseline = tmp_path / "baseline.json"
+    res = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                       rules=["R003"], baseline_path=str(baseline))
+    write_baseline(str(baseline), res.findings)
+    src.write_text("# a new header comment\n" + textwrap.dedent(code))
+    res2 = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                        rules=["R003"], baseline_path=str(baseline))
+    assert not res2.new and res2.findings[0].baselined
+
+
+def test_r000_is_never_baselineable(tmp_path):
+    # Even a baseline that CONTAINS an R000 fingerprint (hand-edited or
+    # written by an old tool) must not accept it: fix the parse error /
+    # write the noqa reason instead.
+    _write(tmp_path, "locust_tpu/hot.py", """
+        import jax
+
+        def drain(blocks):
+            for b in blocks:
+                jax.block_until_ready(b)  # locust: noqa[R003]
+    """)
+    baseline = tmp_path / "baseline.json"
+    res = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                       rules=["R003"], baseline_path=str(baseline))
+    assert sorted(f.rule_id for f in res.new) == ["R000", "R003"]
+    write_baseline(str(baseline), res.findings)  # includes R000 on purpose
+    res2 = run_analysis(paths=["locust_tpu"], root=str(tmp_path),
+                        rules=["R003"], baseline_path=str(baseline))
+    assert [f.rule_id for f in res2.new] == ["R000"]
+
+
+def test_config_fallback_parser_handles_multiline_arrays(tmp_path):
+    # The py3.10 fallback must read the same config tomllib would: a
+    # maintainer wrapping the paths array must not silently revert the
+    # gate to DEFAULTS on 3.10 while 3.11 reads the new value.
+    from locust_tpu.analysis.config import _parse_section_fallback
+
+    section = _parse_section_fallback(textwrap.dedent("""
+        [tool.other]
+        paths = ["decoy"]
+
+        [tool.locust-analysis]
+        # comment line
+        paths = [
+          "locust_tpu",
+          "extras",
+        ]
+        baseline = "b.json"
+
+        [tool.after]
+        baseline = "decoy.json"
+    """))
+    assert section == {"paths": ["locust_tpu", "extras"],
+                       "baseline": "b.json"}
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    _write(tmp_path, "locust_tpu/broken.py", "def f(:\n")
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert [f.rule_id for f in res.new] == ["R000"]
+    assert "does not parse" in res.new[0].message
+
+
+# ------------------------------------------------------- registry + CLI
+
+
+def test_registry_is_closed_and_complete():
+    assert sorted(all_rules()) == [
+        "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
+    ]
+    with pytest.raises(ValueError, match="unknown rule"):
+        get_rules(["R042"])
+
+
+def test_cli_json_gate_green_on_repo(tmp_path):
+    """The CLI surface of the tier-1 gate: exit 0, parseable JSON."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["new"] == 0
+    assert report["rules"] == sorted(all_rules())
+
+
+def test_cli_rule_filter_and_unknown_rule(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--rule", "R042"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+# ------------------------------------------------------------ THE GATE
+
+
+def test_repo_gate_zero_new_findings():
+    """Tier-1: the full rule set over the configured tree (pyproject
+    [tool.locust-analysis]) must report zero non-baselined findings.
+    A new unlocked thread write, impure traced statement, hot-loop sync,
+    fault-site typo, re-spelled wire constant, unpinned python spawn or
+    stray bench print fails the suite right here."""
+    res = run_analysis(root=REPO)
+    assert not res.new, "\n" + "\n".join(f.format() for f in res.new)

@@ -148,6 +148,7 @@ def test_eager_init_watchdog_fires_in_child():
     # a subprocess with a stubbed hanging jax.
     import subprocess, sys, textwrap
 
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     src = textwrap.dedent("""
         import sys, time, types
         sys.path.insert(0, %r)
@@ -157,9 +158,12 @@ def test_eager_init_watchdog_fires_in_child():
         sys.modules["jax"] = fake       # _eager_init's own import sees this
         backend._eager_init(0.5)
         print("UNREACHABLE")
-    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    """ % repo)
+    # Pinned env (R006): drop the ambient axon sitecustomize so the real
+    # `import jax` inside backend can't hang on a wedged tunnel.
     proc = subprocess.run(
-        [sys.executable, "-c", src], capture_output=True, text=True, timeout=30
+        [sys.executable, "-c", src], capture_output=True, text=True, timeout=30,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
     )
     assert proc.returncode == 3
     assert "backend init exceeded" in proc.stderr

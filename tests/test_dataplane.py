@@ -344,7 +344,7 @@ def test_kvbin_rejects_overflow_and_corruption():
         open(p, "wb").write(data[:-3])  # truncated file
         with pytest.raises(ValueError, match="size mismatch"):
             serde.read_kvbin(p, 16)
-        open(p, "wb").write(b"LKVB" + b"\x09" + data[5:])  # future version
+        open(p, "wb").write(b"LKVB" + b"\x09" + data[5:])  # locust: noqa[R005] future-version fixture: the raw spelling pins the ON-DISK magic — if serde's constant drifts, this test must break
         with pytest.raises(ValueError, match="version"):
             serde.read_kvbin(p, 16)
         open(p, "wb").write(data[: serde._KVB_HEADER.size - 2])

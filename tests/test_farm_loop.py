@@ -56,7 +56,7 @@ def test_job_detection_matches_argv_not_cmdline_mentions(monkeypatch, tmp_path):
     driver harness) must not count; a real `python .../bench.py` must."""
     m = _load(monkeypatch, tmp_path)
     # A sleeper whose ARGUMENT mentions the script name: not a job.
-    decoy = subprocess.Popen(
+    decoy = subprocess.Popen(  # locust: noqa[R006] child is a plain sleeper that never imports jax; the test inspects its cmdline, not its behavior
         [sys.executable, "-c",
          "import time,sys; time.sleep(30)", "--note=runs bench.py later"],
     )
@@ -73,7 +73,7 @@ def test_single_instance_exclusion(monkeypatch, tmp_path):
     m = _load(monkeypatch, tmp_path)
     fake = tmp_path / "farm_loop.py"
     fake.write_text("import time; time.sleep(30)\n")
-    p = subprocess.Popen([sys.executable, str(fake)])
+    p = subprocess.Popen([sys.executable, str(fake)])  # locust: noqa[R006] child is a plain sleeper that never imports jax; only its pid/cmdline matter
     try:
         time.sleep(0.3)
         assert p.pid in m._python_procs_running(("farm_loop.py",))
