@@ -155,12 +155,13 @@ class MapReduceEngine:
 
             Sort modes: ONE sort of (table_size + emits_per_block) rows
             does both the block's shuffle-grouping and the cross-block
-            merge.  Mode "hasht": the sort-free scatter fold with its
-            exactness ladder, rebuilt per fold (ops/hash_table.fold_into
-            — see there for why the incremental variant measured worse
-            and is not wired).  Either way the running distinct-key
-            count is measured BEFORE the capacity slice so a truncation
-            in any fold is observable.
+            merge.  The hasht family ("hasht" = scatter combine,
+            "hasht-mxu" = one-hot MXU combine): the sort-free fold with
+            its exactness ladder, rebuilt per fold
+            (ops/hash_table.fold_into — see there for why the
+            incremental variant measured worse and is not wired).
+            Either way the running distinct-key count is measured BEFORE
+            the capacity slice so a truncation in any fold is observable.
             """
             kv, overflow = map_fn(lines, cfg)
             merged, distinct = fold_into(acc, kv, tsize, combine, mode)
@@ -526,12 +527,16 @@ class MapReduceEngine:
         if os.environ.get("LOCUST_DEBUG_CHECKS"):
             # Opt-in invariant sweep on the result table (the sanitizer
             # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.
-            # "hasht" tables are slot-ordered (valid entries scattered by
-            # hash, not compacted to a prefix) — the layout invariant is
-            # a property of the SORT folds, not of correctness.
+            # hasht-family tables are slot-ordered (valid entries
+            # scattered by hash, not compacted to a prefix) — the layout
+            # invariant is a property of the SORT folds, not of
+            # correctness.
+            from locust_tpu.config import HASHT_FAMILY
             from locust_tpu.utils.checks import validate_batch
 
-            validate_batch(acc, expect_compact=self.cfg.sort_mode != "hasht")
+            validate_batch(
+                acc, expect_compact=self.cfg.sort_mode not in HASHT_FAMILY
+            )
         num = int(num_segments)
         truncated = num > acc.size
         if truncated:

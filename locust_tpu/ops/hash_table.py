@@ -33,7 +33,14 @@ Traffic: ~4 rounds x ~11 row-sized gather/scatter sweeps vs the
 incumbent sort's ~21 passes x 6 operands x read+write — roughly 6x less
 HBM movement at the bench shape, IF the backend's duplicate-index
 scatter is not serialized (scripts/bench_sort_variants.py variant J
-measures exactly that primitive; CPU: 19x).
+measures exactly that primitive; CPU: 19x).  On TPU v5e the scatter runs
+but costs ~2.2x the sort-family primitive (J 107.6 ms, ledger ts
+1785523898), so the value combine has a second spelling: a one-hot bf16
+contraction on the systolic MXU (``mxu_scatter_add``, the productized
+K_mxu_hist probe — 52.0 ms / 1.6 s compile at the same shape), selected
+per fold by ``scatter_impl`` / engine sort mode "hasht-mxu"
+(config.HASHT_FAMILY).  Both spellings produce BIT-identical tables;
+roofline treatment in utils/roofline.py (one-hot bytes vs scatter bytes).
 
 Empty-slot sentinel: lane 0 == 0.  A valid emit's key starts with a
 non-delimiter, non-NUL byte packed big-endian into lane 0, so lane 0 of
@@ -46,9 +53,148 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from locust_tpu.config import HASHT_PROBES as DEFAULT_PROBES
+from locust_tpu.config import (
+    HASHT_FAMILY,
+    HASHT_MXU_CHUNK,
+    HASHT_PROBES as DEFAULT_PROBES,
+    hasht_mxu_grid,
+)
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
+
+# How the value-combine scatter of the probe loop is spelled, keyed by the
+# sort mode that selected this fold (config.HASHT_FAMILY):
+#   "xla" — ``.at[slot].add`` duplicate-index scatter (the incumbent;
+#           measured ~2.2x the sort-family primitive on v5e, ledger
+#           J_scatter 107.6 ms vs I 50.7 at the fold shape);
+#   "mxu" — the same sum as one-hot bf16 contractions on the systolic MXU
+#           (``mxu_scatter_add``; the K_mxu_hist probe measured 52.0 ms
+#           with a 1.6 s compile at the identical shape).
+# The claim (scatter-min over folded hashes) and key-lane writes stay XLA
+# scatters under BOTH impls — the MXU speaks only +, and those steps are
+# what make the fold exact, not what prices it.
+SCATTER_IMPLS = ("xla", "mxu")
+
+
+def scatter_impl_for(sort_mode: str) -> str:
+    """The fold family's mode -> combine-scatter spelling map (the one
+    place "hasht-mxu" is interpreted; engines pass sort_mode strings)."""
+    return "mxu" if sort_mode == "hasht-mxu" else "xla"
+
+
+def mxu_scatter_add(
+    slot: jax.Array,
+    values: jax.Array,
+    mask: jax.Array,
+    out_size: int,
+    chunk: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Duplicate-index scatter-add spelled as one-hot MXU contractions.
+
+    Returns ``(sums, hit)``: ``sums[t]`` is the int32 sum (mod 2^32 —
+    BIT-identical to XLA's wrapping ``.at[t].add``) of ``values`` over
+    masked rows with ``slot == t``, and ``hit[t]`` is True iff any masked
+    row landed on ``t``.  Rows with ``mask`` False (or an out-of-grid
+    slot) contribute nothing.
+
+    Formulation (productized from scripts/bench_sort_variants.py
+    ``variant_k``): decompose ``slot = hi * t_lo + lo`` on the
+    ``config.hasht_mxu_grid`` and accumulate
+    ``hist[w, hi, lo] = sum_n W[n, w] * onehot_hi[n, hi] * onehot_lo[n, lo]``
+    as ONE ``[t_hi * 5, n_chunk] x [n_chunk, t_lo]`` bf16 contraction per
+    chunk.  Exactness, unlike the probe's bf16-cast of raw values, is
+    unconditional: the 5 weight planes are the value's four unsigned
+    8-bit limbs plus the hit count — every operand entry is <= 255 and
+    hence bf16-exact, per-chunk partials accumulate in fp32 where a
+    slot's limb sum stays < 255 * chunk <= 2^24 (config.HASHT_MXU_CHUNK's
+    validated ceiling), partials then convert to uint32 and accumulate
+    with wraparound, and the final limb recombination is mod-2^32
+    arithmetic — the same ring int32 scatter-add lives in.
+
+    The n axis is chunked (``lax.scan``) so the materialized one-hot
+    operands stay ~``chunk * (5 * t_hi + t_lo) * 2`` bytes regardless of
+    the fold's row count.
+    """
+    t_hi, t_lo = hasht_mxu_grid(out_size)
+    n = slot.shape[0]
+    chunk = HASHT_MXU_CHUNK if chunk is None else chunk
+    if not 1 <= chunk <= 65536:
+        # The SAME exactness ceiling config validates for the env knob:
+        # a slot's per-chunk limb partial must stay < 255 * chunk <= 2^24
+        # or the fp32 einsum accumulation rounds and the bit-identity
+        # contract silently breaks for direct callers.
+        raise ValueError(
+            f"chunk must be in [1, 65536] (fp32 partial-sum exactness "
+            f"bound 2^24/255), got {chunk}"
+        )
+
+    # 5 weight planes, all bf16-exact: value limbs 0..3 (unsigned view of
+    # the int32 — the limb recombination below restores wrapping-sum
+    # semantics for negative values too) + the hit count.
+    w_u = jax.lax.bitcast_convert_type(
+        values.astype(jnp.int32), jnp.uint32
+    )
+    w_u = jnp.where(mask, w_u, jnp.uint32(0))
+    planes = [(w_u >> jnp.uint32(8 * b)) & jnp.uint32(0xFF) for b in range(4)]
+    planes.append(mask.astype(jnp.uint32))
+    weights = jnp.stack(planes, axis=-1).astype(jnp.bfloat16)   # [n, 5]
+    s32 = slot.astype(jnp.int32)
+    hi = s32 // t_lo
+    lo = s32 % t_lo
+
+    def hist_chunk(hi_c, lo_c, w_c):
+        # One-hot rows land in their grid cell; a masked or out-of-grid
+        # row produces an all-zero one-hot / zero weight either way.
+        oh_hi = (
+            hi_c[:, None] == jnp.arange(t_hi, dtype=jnp.int32)[None, :]
+        ).astype(jnp.bfloat16)
+        oh_lo = (
+            lo_c[:, None] == jnp.arange(t_lo, dtype=jnp.int32)[None, :]
+        ).astype(jnp.bfloat16)
+        lhs = (oh_hi[:, :, None] * w_c[:, None, :]).reshape(
+            hi_c.shape[0], t_hi * 5
+        )
+        part = jnp.einsum(
+            "nm,nl->ml", lhs, oh_lo, preferred_element_type=jnp.float32
+        ).reshape(t_hi, 5, t_lo)
+        # fp32 partials are exact integers < 2^24 here; uint32 conversion
+        # is therefore exact, and uint32 accumulation wraps mod 2^32.
+        return part.astype(jnp.uint32)
+
+    if n <= chunk:
+        acc = hist_chunk(hi, lo, weights)
+    else:
+        n_chunks = -(-n // chunk)
+        pad = n_chunks * chunk - n
+        hi_p = jnp.pad(hi, (0, pad), constant_values=-1)  # off-grid: no-op
+        lo_p = jnp.pad(lo, (0, pad), constant_values=-1)
+        w_p = jnp.pad(weights, ((0, pad), (0, 0)))
+
+        def body(carry, xs):
+            h, l, w = xs
+            return carry + hist_chunk(h, l, w), None
+
+        acc, _ = jax.lax.scan(
+            body,
+            jnp.zeros((t_hi, 5, t_lo), jnp.uint32),
+            (
+                hi_p.reshape(n_chunks, chunk),
+                lo_p.reshape(n_chunks, chunk),
+                w_p.reshape(n_chunks, chunk, 5),
+            ),
+        )
+
+    sums_u = (
+        acc[:, 0]
+        + (acc[:, 1] << jnp.uint32(8))
+        + (acc[:, 2] << jnp.uint32(16))
+        + (acc[:, 3] << jnp.uint32(24))
+    )
+    sums = jax.lax.bitcast_convert_type(
+        sums_u.reshape(-1)[:out_size], jnp.int32
+    )
+    hit = acc[:, 4].reshape(-1)[:out_size] > 0
+    return sums, hit
 
 # DEFAULT_PROBES (config.HASHT_PROBES, default 4): at the bench load
 # factor (~5.6k distinct in 65,536 slots ≈ 0.09) the expected unresolved
@@ -68,8 +214,17 @@ def hash_aggregate(
     combine: str = "sum",
     probes: int = DEFAULT_PROBES,
     table: KVBatch | None = None,
+    scatter_impl: str = "xla",
 ) -> tuple[KVBatch, jax.Array, jax.Array]:
     """Aggregate ``batch`` into an ``out_size``-slot table without sorting.
+
+    ``scatter_impl`` selects how step 4's value combine is spelled (see
+    ``SCATTER_IMPLS``): "xla" is the duplicate-index scatter, "mxu" the
+    one-hot contraction — tables are BIT-identical either way (the "mxu"
+    sum is exact mod 2^32, the ring int32 scatter-add lives in).  "mxu"
+    applies to combine="sum" only; min/max have no matmul spelling and
+    keep the XLA scatter (trivially identical).  Steps 1-3 (claim, key
+    write, full-lane verify) are unchanged under both impls.
 
     With ``table`` (a KVBatch of capacity ``out_size`` produced by a
     previous hasht fold), aggregation is INCREMENTAL: prior keys keep
@@ -105,6 +260,10 @@ def hash_aggregate(
     """
     if combine not in _COMBINE_INIT:
         raise ValueError(f"combine must be one of {sorted(_COMBINE_INIT)}")
+    if scatter_impl not in SCATTER_IMPLS:
+        raise ValueError(
+            f"scatter_impl must be one of {SCATTER_IMPLS}, got {scatter_impl!r}"
+        )
     lanes, values, valid = batch.key_lanes, batch.values, batch.valid
     n_lanes = lanes.shape[-1]
     T = out_size
@@ -181,15 +340,24 @@ def hash_aggregate(
         match = unresolved & jnp.all(
             stored_lanes[slot] == lanes, axis=-1
         )
-        # 4. Combine resolved values into the slot (dump row otherwise).
-        vslot = jnp.where(match, slot, T)
-        matched_slot = matched_slot.at[vslot].set(True, mode="drop")
-        if combine == "sum":
-            acc = acc.at[vslot].add(values, mode="drop")
-        elif combine == "min":
-            acc = acc.at[vslot].min(values, mode="drop")
+        # 4. Combine resolved values into the slot.  "mxu" + sum: the
+        #    scatter-add and the matched-slot flag both come out of one
+        #    one-hot contraction (mxu_scatter_add's value limbs + hit
+        #    plane); otherwise the duplicate-index scatter with a dump
+        #    row.  Identical tables by construction either way.
+        if scatter_impl == "mxu" and combine == "sum":
+            sums, hit = mxu_scatter_add(slot, values, match, T)
+            acc = acc.at[:T].add(sums)
+            matched_slot = matched_slot.at[:T].set(matched_slot[:T] | hit)
         else:
-            acc = acc.at[vslot].max(values, mode="drop")
+            vslot = jnp.where(match, slot, T)
+            matched_slot = matched_slot.at[vslot].set(True, mode="drop")
+            if combine == "sum":
+                acc = acc.at[vslot].add(values, mode="drop")
+            elif combine == "min":
+                acc = acc.at[vslot].min(values, mode="drop")
+            else:
+                acc = acc.at[vslot].max(values, mode="drop")
         unresolved = unresolved & ~match
 
     used = (stored_lanes[:T, 0] != 0) & matched_slot[:T]
@@ -302,7 +470,8 @@ def place_residual(
 
 
 def combine_or_passthrough(
-    batch: KVBatch, combine: str, probes: int = 2
+    batch: KVBatch, combine: str, probes: int = 2,
+    scatter_impl: str = "xla",
 ) -> KVBatch:
     """Opportunistic pre-aggregation with an O(n) worst case — no sort.
 
@@ -326,7 +495,9 @@ def combine_or_passthrough(
         )
     N = batch.size
     n_lanes = batch.key_lanes.shape[-1]
-    table, used, unresolved = hash_aggregate(batch, N, combine, probes=probes)
+    table, used, unresolved = hash_aggregate(
+        batch, N, combine, probes=probes, scatter_impl=scatter_impl
+    )
 
     def fast(_):
         return table
@@ -368,13 +539,16 @@ def reduce_into(
 
     Every bounded-table fold site (engine block fold, mesh per-shard
     merge, hierarchical cross-slice combine) calls this instead of
-    hand-rolling the ``if sort_mode == "hasht"`` branch — a new
+    hand-rolling the ``if sort_mode in HASHT_FAMILY`` branch — a new
     fold-level strategy lands here once, not in four files.  (The mesh
     LOCAL COMBINER is the one deliberate exception: aggregation there is
     optional, so it uses ``combine_or_passthrough``.)
     """
-    if sort_mode == "hasht":
-        return aggregate_exact(batch, out_size, combine)
+    if sort_mode in HASHT_FAMILY:
+        return aggregate_exact(
+            batch, out_size, combine,
+            scatter_impl=scatter_impl_for(sort_mode),
+        )
     from locust_tpu.ops.process_stage import sort_and_compact
     from locust_tpu.ops.reduce_stage import segment_reduce_into
 
@@ -399,8 +573,9 @@ def fold_into(
     * sort modes: ``concat(acc, batch)`` then one sort + segment reduce
       — the table IS sorted back in with the emits (one fused sort does
       grouping and merge);
-    * "hasht": ``aggregate_exact`` over the same concat — a per-fold
-      REBUILD, deliberately NOT the incremental
+    * the hasht family ("hasht" / "hasht-mxu", differing only in the
+      combine-scatter spelling): ``aggregate_exact`` over the same
+      concat — a per-fold REBUILD, deliberately NOT the incremental
       ``hash_aggregate(table=acc)`` mode.  Measured round 5 (CPU bench,
       hamlet-repeated 8MB): incremental wiring LOST — 8.1 -> 6.5 MB/s
       and distinct drifted 5608 -> 5631, because a key the probe rounds
@@ -413,8 +588,11 @@ def fold_into(
       stranded keys — future work; the capability + its exactness
       contract stay tested at the hash_aggregate level.
     """
-    if sort_mode == "hasht":
-        return aggregate_exact(KVBatch.concat(acc, batch), out_size, combine)
+    if sort_mode in HASHT_FAMILY:
+        return aggregate_exact(
+            KVBatch.concat(acc, batch), out_size, combine,
+            scatter_impl=scatter_impl_for(sort_mode),
+        )
     from locust_tpu.ops.process_stage import sort_and_compact
     from locust_tpu.ops.reduce_stage import segment_reduce_into
 
@@ -431,6 +609,7 @@ def aggregate_exact(
     combine: str = "sum",
     probes: int | None = None,
     into: KVBatch | None = None,
+    scatter_impl: str = "xla",
 ) -> tuple[KVBatch, jax.Array]:
     """The full sort-free fold with its exactness ladder, as one call.
 
@@ -438,6 +617,14 @@ def aggregate_exact(
     switches :func:`hash_aggregate` to its incremental mode; the ladder
     below is unchanged — its ``small``/``full`` branches already merge
     residual rows into an arbitrary existing table.
+
+    ``scatter_impl`` reaches only the probe loop's value combine
+    (:func:`hash_aggregate`).  The residual/overflow branches stay
+    sort-based under BOTH impls: they exist to be exact on the handful of
+    rows the probes strand, their sorts are capacity-bounded
+    (RESIDUAL_CAP), and — because the probe loop's table is bit-identical
+    across impls — the branch a given batch takes, and the rows it sees,
+    are identical too.
 
     ``hash_aggregate`` + the three-way unresolved-row ladder the engine's
     "hasht" fold documents (engine.fold_block_hasht): 0 unresolved → the
@@ -471,6 +658,7 @@ def aggregate_exact(
         batch, out_size, combine,
         probes=DEFAULT_PROBES if probes is None else probes,
         table=into,
+        scatter_impl=scatter_impl,
     )
     n_unres = jnp.sum(unresolved.astype(jnp.int32))
 

@@ -40,6 +40,7 @@ import logging
 import jax
 import jax.numpy as jnp
 
+from locust_tpu.config import HASHT_FAMILY
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 
@@ -106,12 +107,14 @@ def sort_and_compact(batch: KVBatch, mode: str = "hash") -> KVBatch:
         return _hashp2_sort(batch)
     if mode == "hashp1":
         return _hashp1_sort(batch)
-    if mode == "hasht":
-        # "hasht" is a FOLD-level strategy (ops/hash_table.aggregate_exact;
-        # wired in engine.fold_block_hasht and the mesh engines' merge /
-        # combiner sites); consumers of the grouping interface proper
-        # (timed_run's split stages, the staged CLI) get the stock
-        # formulation with the same key-grouping guarantees.
+    if mode in HASHT_FAMILY:
+        # The hasht family is a FOLD-level strategy
+        # (ops/hash_table.aggregate_exact — "hasht-mxu" only changes the
+        # fold's combine-scatter spelling; wired in engine.fold_block and
+        # the mesh engines' merge / combiner sites); consumers of the
+        # grouping interface proper (timed_run's split stages, the staged
+        # CLI) get the stock formulation with the same key-grouping
+        # guarantees.
         return _hashp1_sort(batch)
     if mode == "hash1":
         return _hash1_sort(batch)

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from locust_tpu.config import EngineConfig
+from locust_tpu.config import HASHT_FAMILY, EngineConfig
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
 from locust_tpu.ops.map_stage import wordcount_map
@@ -511,17 +511,24 @@ def build_shuffle_step(
         # Local combiner: same capacity contract either way (output size ==
         # kv.size, the shape partition_to_bins was sized for); partition is
         # order-agnostic, so neither hasht's slot-ordered table nor the
-        # passthrough's raw rows need grouping.  hasht here uses
-        # combine_or_passthrough: aggregation at this site is an
+        # passthrough's raw rows need grouping.  The hasht family here
+        # uses combine_or_passthrough: aggregation at this site is an
         # OPTIMIZATION (every destination re-reduces), so when probing
         # fails under a distinct-heavy load the fallback is an O(n)
         # compaction, not a sort — worst case = 2 probe sweeps + one
         # compaction, full win kept on duplicate-heavy (WordCount-like)
-        # blocks.
-        if cfg.sort_mode == "hasht":
-            from locust_tpu.ops.hash_table import combine_or_passthrough
+        # blocks.  "hasht-mxu" carries its combine-scatter spelling into
+        # the combiner's probe rounds too (scatter_impl_for).
+        if cfg.sort_mode in HASHT_FAMILY:
+            from locust_tpu.ops.hash_table import (
+                combine_or_passthrough,
+                scatter_impl_for,
+            )
 
-            local_table = combine_or_passthrough(kv, combine, probes=2)
+            local_table = combine_or_passthrough(
+                kv, combine, probes=2,
+                scatter_impl=scatter_impl_for(cfg.sort_mode),
+            )
         else:
             local_table = reduce_into(kv, kv.size, combine, cfg.sort_mode)[0]
         acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(

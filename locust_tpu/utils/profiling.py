@@ -82,6 +82,15 @@ SORT_OP_FRAGMENTS = ("sort", "custom-call", "tpu_custom_call", "mosaic")
 # with its scatter-round traffic model (utils/roofline.py).
 SCATTER_OP_FRAGMENTS = ("scatter", "gather")
 
+# "hasht-mxu" moves the value combine into one-hot contractions that
+# lower to dot HLOs ("dot.N" / dot_general) — time the scatter family
+# misses entirely.  Tracked separately so the mode's measured Process
+# device time can pair with a traffic model that INCLUDES the one-hot
+# bytes (roofline est_onehot_bytes); pairing those bytes with a time
+# that excludes the dots would inflate utilization (could exceed 100%).
+# NOT "conv": that substring also matches "convert.N" casts.
+DOT_OP_FRAGMENTS = ("dot",)
+
 
 def parse_xplane(path: str, top_n: int = 12) -> dict:
     """Reduce one ``*.xplane.pb`` to per-plane op-name duration totals.
@@ -138,6 +147,7 @@ def parse_xplane(path: str, top_n: int = 12) -> dict:
                 "top_ops": [[n, round(ms, 3)] for n, ms in top],
                 "sort_ms": family_ms(SORT_OP_FRAGMENTS),
                 "scatter_ms": family_ms(SCATTER_OP_FRAGMENTS),
+                "dot_ms": family_ms(DOT_OP_FRAGMENTS),
             }
 
     device = next(
@@ -149,6 +159,7 @@ def parse_xplane(path: str, top_n: int = 12) -> dict:
         out["device_total_ms"] = planes[device]["total_ms"]
         out["sort_ms"] = planes[device]["sort_ms"]
         out["scatter_ms"] = planes[device]["scatter_ms"]
+        out["dot_ms"] = planes[device]["dot_ms"]
     return out
 
 

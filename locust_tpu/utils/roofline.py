@@ -22,6 +22,11 @@ Model (documented limits, all stated in the emitted row):
 * The radix mode does ``ceil(32/8)=4`` LSD counting passes instead
   (ops/radix_sort.py), each streaming key + rank arrays, plus one final
   payload gather.
+* The sort-free hasht family is modeled as probe-round row sweeps
+  (``sort_pass_count``); "hasht-mxu" replaces the value-combine sweep
+  with the MXU histogram's one-hot operand traffic (reported separately
+  as ``est_onehot_bytes`` — the one-hot-bytes-vs-scatter-bytes tradeoff
+  the engine A/B decides), sized off ``config.hasht_mxu_grid``.
 * The fused fold (engine.fold_block) does ONE sort of
   ``table_size + emits_per_block`` rows per block — the accumulator is
   concatenated with the block's emits so grouping and cross-block merge
@@ -59,6 +64,9 @@ _MODE_OPERANDS = {
     "hashp2": (2, None, False),  # folded hash + h2 tiebreak + row payload
     "hashp1": (1, None, False),  # folded hash only + row payload
     "hasht": (1, None, False),  # scatter rounds modeled via sort_pass_count
+    # hasht-mxu: claim/verify row sweeps via sort_pass_count; the value
+    # combine's traffic moves to the one-hot term (pipeline_sort_traffic).
+    "hasht-mxu": (1, None, False),
     "hash1": (2, 0, True),     # (folded key, idx), then row gather
     "radix": (2, 0, True),     # folded key + rank arrays, then row gather
     "bitonic": (1, None, False),  # folded key + row payload, VMEM tiles
@@ -96,6 +104,14 @@ def sort_pass_count(n_rows: int, mode: str = "hash") -> int:
         from locust_tpu.config import HASHT_PROBES
 
         return 2 * HASHT_PROBES
+    if mode == "hasht-mxu":
+        # Same probe rounds, but the value-combine scatter's row sweep is
+        # replaced by the MXU histogram: ~1 row-sized sweep per round
+        # remains (claim + lanes-verify), and the combine is priced by
+        # the one-hot term in pipeline_sort_traffic instead.
+        from locust_tpu.config import HASHT_PROBES
+
+        return HASHT_PROBES
     k = math.ceil(math.log2(n_rows))
     if mode == "bitonic":
         # HBM round-trips of the Pallas tiled network = entries in the
@@ -139,13 +155,37 @@ def pipeline_sort_traffic(
     passes = sort_pass_count(n_rows, sort_mode)
     # Each pass reads and writes every operand byte.
     per_block = n_rows * (2 * per_pass * passes + gather)
-    return {
+    out = {
         "sort_mode": sort_mode,
         "rows_per_sort": n_rows,
         "sort_passes": passes,
         "n_blocks": n_blocks,
-        "est_sort_traffic_bytes": int(n_blocks * per_block),
     }
+    if sort_mode == "hasht-mxu":
+        # The one-hot term: per probe round the combine materializes and
+        # contracts bf16 one-hot operands (the 5 weight planes ride the
+        # hi operand — hash_table.mxu_scatter_add's [n, 5*t_hi] lhs and
+        # [n, t_lo] rhs, write + read = x2x2) plus one fp32 partial
+        # histogram per chunk.  Grid/chunk read from the SAME validated
+        # config values the kernel runs with (config.hasht_mxu_grid) so
+        # the modeled bytes can't drift from the contraction's operands.
+        from locust_tpu.config import (
+            HASHT_MXU_CHUNK,
+            HASHT_PROBES,
+            hasht_mxu_grid,
+        )
+
+        t_hi, t_lo = hasht_mxu_grid(table_size)
+        n_chunks = max(1, -(-n_rows // HASHT_MXU_CHUNK))
+        onehot = HASHT_PROBES * (
+            n_rows * 2 * 2 * (5 * t_hi + t_lo)
+            + n_chunks * 4 * 5 * t_hi * t_lo
+        )
+        per_block += onehot
+        out["est_onehot_bytes"] = int(n_blocks * onehot)
+        out["mxu_grid"] = [t_hi, t_lo]
+    out["est_sort_traffic_bytes"] = int(n_blocks * per_block)
+    return out
 
 
 def summarize(

@@ -217,8 +217,11 @@ def variant_j(lanes, values, valid):
 def variant_k(lanes, values, valid):
     """MXU histogram probe: scatter-add spelled as a one-hot matmul.
 
-    The backup primitive for a sort-free Process stage if variant J
-    shows XLA's duplicate-index scatter is serialized on TPU.  Decompose
+    PRODUCTIZED (round 6) as ``ops/hash_table.mxu_scatter_add`` behind
+    engine sort mode "hasht-mxu" — this probe stays as the cheap
+    primitive-level A/B against variant J (the exact engine spelling
+    adds value limbs + the hit plane for bit-exactness; the engine-level
+    verdict rides opp_resume.AB_SORT_MODES).  Decompose
     the bucket id as ``hi * 512 + lo`` and accumulate
     ``counts2d[h, l] = sum_n value_n * onehot_hi[n, h] * onehot_lo[n, l]``
     — ONE ``[128, n] x [n, 512]`` bf16 contraction on the MXU (~47

@@ -32,12 +32,17 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 # Engine sort modes covered by the end-to-end A/B (phase 3).
 # Priority order: a short window should answer the open questions first —
 # the sort-free hasht fold (VERDICT r4 next #2: the highest-expected-value
-# unknown, ~6x modeled traffic cut, zero TPU measurements), then the
-# Pallas bitonic kernel (capped-fusion Mosaic verdict), then the measured
-# winner hashp2 so the window always re-anchors the incumbent — before
-# re-timing the also-rans.
-AB_SORT_MODES = ("hasht", "bitonic", "hashp2", "hashp1", "hashp", "hash",
-                 "hash1", "radix")
+# unknown, ~6x modeled traffic cut, zero TPU measurements), then its
+# MXU-combine variant hasht-mxu (VERDICT r5 item 8: the K_mxu_hist
+# primitive at 52.0 ms / 1.6 s compile vs the J scatter's 107.6, armed
+# here as an engine-level row), then the measured winner hashp2 so the
+# window always re-anchors the incumbent — before re-timing the
+# also-rans.  The Pallas bitonic kernel is DEMOTED to last (VERDICT r5
+# item 4): it measured a 1.26x loser with a 100.7 s compile that eats
+# ~15% of a 12-minute window, so it runs only after every productive
+# mode has a row; tests pin the hasht-family-before-bitonic ordering.
+AB_SORT_MODES = ("hasht", "hasht-mxu", "hashp2", "hashp1", "hashp", "hash",
+                 "hash1", "radix", "bitonic")
 
 # Engines memoized by their frozen EngineConfig: several phases measure
 # the SAME winning configuration (block A/B winner -> pallas False side
@@ -118,6 +123,7 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
         row["device_total_ms"] = summary.get("device_total_ms")
         row["sort_device_ms"] = summary.get("sort_ms")
         row["scatter_device_ms"] = summary.get("scatter_ms")
+        row["dot_device_ms"] = summary.get("dot_ms")
         if summary.get("error"):
             row["error"] = summary["error"]
         plane = (summary.get("planes") or {}).get(row.get("device_plane"))
@@ -145,13 +151,23 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
         )
         row["est_sort_traffic_bytes"] = model["est_sort_traffic_bytes"]
         peak = roofline.PEAK_HBM_GB_S.get(jax.devices()[0].device_kind)
-        # The sort-free hasht fold's Process work is scatters + probe
+        # The sort-free hasht family's Process work is scatters + probe
         # gathers, never "sort.*" HLOs — pair its traffic model with the
-        # scatter family; sort modes pair with the sort family.
+        # scatter family; sort modes pair with the sort family.  For
+        # hasht-mxu the model ADDS the one-hot bytes (roofline
+        # est_onehot_bytes), so the time side must add the dot family the
+        # contraction lowers to — pairing one-hot-dominated bytes with a
+        # dot-free time would inflate utilization past honesty (review
+        # finding, r6).
+        from locust_tpu.config import HASHT_FAMILY
+
         sort_ms = row.get("sort_device_ms")
-        if sort_mode == "hasht":
+        if sort_mode in HASHT_FAMILY:
             sort_ms = (row.get("scatter_device_ms") or 0) + (sort_ms or 0)
             row["process_family"] = "scatter+sort"
+            if sort_mode == "hasht-mxu":
+                sort_ms += row.get("dot_device_ms") or 0
+                row["process_family"] = "scatter+sort+dot"
         if sort_ms and peak:
             # The model is an upper bound on traffic; this quotient is
             # therefore an upper bound on utilization FROM MEASURED TIME
@@ -786,10 +802,13 @@ def phase_stage_breakdown(rows_ab, corpus_bytes, sort_mode: str,
             "total_ms": round(best.times.total_ms, 1),
             "distinct": best.num_segments,
         }
-        if sort_mode == "hasht":
+        from locust_tpu.config import HASHT_FAMILY
+
+        if sort_mode in HASHT_FAMILY:
             # timed_run splits stages via the grouping interface, which
-            # for hasht is the stock hashp1 formulation — the fused fold
-            # (the number that wins A/Bs) has no separable Process/Reduce.
+            # for the hasht family is the stock hashp1 formulation — the
+            # fused fold (the number that wins A/Bs) has no separable
+            # Process/Reduce.
             row["note"] = "stages measured via hashp1-equivalent split"
     except Exception as e:  # noqa: BLE001 - informational phase: a failure
         # here must not kill stage_parity/emits/key-width/stream behind it

@@ -8,13 +8,18 @@ DECISION VALUE per compile-second (each already-session-answered phase is
 skipped, see _session_row_ok):
 
   1. sort-variant bench at the engine's true Process-stage shape —
-     only the variants this session hasn't measured yet
-  2. the shared opp_resume phases: engine sort-mode A/B (hasht verdict,
-     steers bench's evidence tuning) -> block/table/pallas A/Bs ->
-     stage device-time decomposition -> profiler capture -> parity
-     tables -> (optional, $LOCUST_OPP_STREAM_MB) bounded-RSS streaming
-  3. the Pallas check battery (scripts/tpu_checks.py subprocess) —
-     fused/tile ladders + tokenize checks, the window's long tail
+     only the PRODUCTIVE variants this session hasn't measured yet (the
+     Pallas bitonic variant H is demoted to phase 3)
+  2. the shared opp_resume phases: engine sort-mode A/B (hasht +
+     hasht-mxu verdicts first, bitonic last — steers bench's evidence
+     tuning) -> block/table/pallas A/Bs -> stage device-time
+     decomposition -> profiler capture -> parity tables -> (optional,
+     $LOCUST_OPP_STREAM_MB) bounded-RSS streaming
+  3. the demoted bitonic phases: variant H (100.7 s compile for a
+     measured 1.26x loser, VERDICT r5 item 4 — never before the
+     productive rows), then the Pallas check battery
+     (scripts/tpu_checks.py subprocess) — fused/tile ladders + tokenize
+     checks, the window's long tail
 
 Exit codes: 0 = all requested phases captured, 3 = tunnel down, 1 = error.
 """
@@ -122,11 +127,15 @@ def main() -> int:
     # Priority order (a short window should answer the open question
     # first): J = the hasht scatter primitive (VERDICT r4 next #2: is the
     # .at[].add serialized on TPU, the single biggest unknown on the
-    # headline), K = the MXU-histogram backup for the same role, H = the
-    # Pallas bitonic kernel, C = the payload-carry incumbent, then the
+    # headline), K = the MXU-histogram primitive now productized as the
+    # hasht-mxu engine mode, C = the payload-carry incumbent, then the
     # rest; radix (E/F) last — already measured losers (2.5-3x), only
-    # re-timed if the window is generous.  Once a window has answered
-    # J/K/H (a TPU row covering them, < 24h old), later windows in the
+    # re-timed if the window is generous.  H (the Pallas bitonic kernel)
+    # is DEMOTED out of this phase entirely (VERDICT r5 item 4: 1.26x
+    # loser, 100.7 s compile): it runs as its own phase AFTER the engine
+    # A/Bs, so the hasht/hasht-mxu engine rows always land before any
+    # bitonic compile can eat the window.  Once a window has answered
+    # J/K (a TPU row covering them, < 24h old), later windows in the
     # same session skip straight to the engine phases — each variant
     # costs a fresh 10-100s tunnel compile, and re-answering a settled
     # primitive question starves the end-to-end A/Bs behind it.
@@ -138,17 +147,17 @@ def main() -> int:
     # restarts), with a session-ts floor for legacy unstamped rows — the
     # ONE validity rule, opp_resume._session_row_ok, shared by both
     # sweep entry points.
-    priority = ("J", "K", "H", "I", "G", "C", "B", "D", "E", "F")
+    priority = ("J", "K", "I", "G", "C", "B", "D", "E", "F")
     answered = _answered_variant_letters(sweep_n)
-    if not {"J", "K", "H"} - answered:
+    if not {"J", "K"} - answered:
         # The open questions are measured; the also-rans alone don't
         # justify re-paying a window's tunnel compiles.
         print("[opp] sort variants already answered this session "
               f"(answered: {sorted(answered)}); skipping", file=sys.stderr)
     else:
         # Only the UNANSWERED variants, priority order preserved: a
-        # window that died after measuring J and K must spend its
-        # successor's compiles on H, not on re-measuring J and K.
+        # window that died after measuring J must spend its successor's
+        # compiles on K, not on re-measuring J.
         env["LOCUST_SORT_VARIANTS"] = ",".join(
             v for v in priority if v not in answered
         )
@@ -164,12 +173,30 @@ def main() -> int:
 
     # Phases 2.5 -> 4 are shared with the window-resume entry point
     # (scripts/opp_resume.py) so the two sweeps can never diverge.
-    # They run BEFORE the Pallas check battery: the engine sort-mode A/B
-    # (hasht verdict — the round's highest-expected-value unknown, and
-    # the input bench's evidence tuning adopts) must not starve behind
-    # 560s of kernel-ladder compiles whose headline deliverable (a
-    # Pallas hardware ms) the variant phase already landed.
+    # They run BEFORE the Pallas check battery AND before the demoted
+    # bitonic variant: the engine sort-mode A/B (hasht + hasht-mxu
+    # verdicts — the round's highest-expected-value unknowns, and the
+    # input bench's evidence tuning adopts) must not starve behind 560s
+    # of kernel-ladder compiles whose headline deliverable (a Pallas
+    # hardware ms) is a measured loser (VERDICT r5 item 4).
     opp_resume.run_phases()
+
+    # Demoted bitonic variant phase (H): only after the productive
+    # engine-level A/Bs have had the window.  A 100.7 s compile for a
+    # measured 1.26x loser is the LAST thing a scarce window should pay
+    # for — but the ladder stays armed so a schedule fix can still be
+    # vindicated on hardware.
+    if "H" not in _answered_variant_letters(sweep_n):
+        env_h = dict(os.environ)
+        env_h["N"] = str(sweep_n)
+        env_h["LOCUST_SORT_VARIANTS"] = "H"
+        _run_phase(
+            "sort variants (demoted bitonic)",
+            [sys.executable,
+             os.path.join(REPO, "scripts", "bench_sort_variants.py"),
+             "--backend", "tpu"],
+            env_h, 560,
+        )
 
     # Drop the engine memo (compiled executables + any captured device
     # buffers) before spawning the battery: on the one-chip axon backend
