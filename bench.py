@@ -528,6 +528,87 @@ def _dataplane_stats() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _stream_stats(eng, rows) -> dict:
+    """Zero-stall streaming summary for the one-line JSON (docs/DESIGN.md).
+
+    Folds the bench corpus through ``run_stream`` twice — plain, then
+    WITH checkpoints on the async background writer — and reports the
+    executor's stall accounting: backpressure stall ms, checkpoint
+    mark/flush ms, overlap efficiency, and checkpoint lag (latest-wins
+    skips).  The contract under test is that snapshots no longer stall
+    the fold loop: ckpt_overhead_pct should sit within a few percent.
+    Guarded like the dataplane summary — a failure here must never cost
+    the headline line; ``LOCUST_BENCH_STREAM=0`` skips outright.  On TPU
+    the streamed volume is capped (``LOCUST_BENCH_STREAM_BYTES``,
+    default 8MB there): per-block dispatch over the remote tunnel must
+    not burn a scarce window the one-dispatch headline needs.
+    """
+    if os.environ.get("LOCUST_BENCH_STREAM", "1") == "0":
+        return {"skipped": True}
+    try:
+        import tempfile
+
+        import jax
+
+        bl, w = eng.cfg.block_lines, eng.cfg.line_width
+        cap_default = 8 << 20 if jax.default_backend() == "tpu" else 0
+        cap = int(os.environ.get("LOCUST_BENCH_STREAM_BYTES", cap_default))
+        n = rows.shape[0] if cap <= 0 else min(rows.shape[0], max(bl, cap // w))
+        srows = rows[:n]
+
+        def blocks():
+            for i in range(0, srows.shape[0], bl):
+                yield srows[i : i + bl]
+
+        t0 = time.perf_counter()
+        eng.run_stream((srows[i : i + bl] for i in range(0, 2 * bl, bl)))
+        warm_s = time.perf_counter() - t0  # per-block fold compile
+        t0 = time.perf_counter()
+        plain = eng.run_stream(blocks())
+        plain_s = time.perf_counter() - t0
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            ck = eng.run_stream(
+                blocks(),
+                checkpoint_dir=os.path.join(td, "ck"),
+                every=8,
+                fingerprint="bench-stream",
+            )
+            ck_s = time.perf_counter() - t0
+        cks = dict(ck.stream.get("ckpt") or {})
+        stall = float(ck.stream["backpressure_stall_ms"])
+        mark = float(cks.get("mark_ms") or 0.0)
+        total = float(ck.stream["total_ms"]) or 1.0
+        out = {
+            "streamed_mb": round(srows.nbytes / 1e6, 1),
+            "blocks": ck.stream["blocks"],
+            "compile_s": round(warm_s, 2),
+            "plain_s": round(plain_s, 3),
+            "ckpt_s": round(ck_s, 3),
+            "ckpt_overhead_pct": round(100 * (ck_s - plain_s) / plain_s, 2),
+            "backpressure_stall_ms": round(stall, 1),
+            "ckpt_mark_ms": round(mark, 1),
+            "ckpt_final_flush_ms": cks.get("final_flush_ms"),
+            "ckpt_mode": cks.get("mode"),
+            "ckpt_written": cks.get("written"),
+            "ckpt_skipped": cks.get("skipped"),
+            "ckpt_max_lag": cks.get("max_lag"),
+            "overlap_pct": round(100 * (1 - (stall + mark) / total), 2),
+            "distinct": ck.num_segments,
+            "distinct_matches": ck.num_segments == plain.num_segments,
+        }
+        print(
+            f"[bench] stream: plain {plain_s:.2f}s vs ckpt {ck_s:.2f}s "
+            f"({out['ckpt_overhead_pct']:+.1f}%), stall {stall:.0f}ms, "
+            f"mark {mark:.0f}ms, lag {cks.get('max_lag')}, "
+            f"distinct {ck.num_segments}",
+            file=sys.stderr,
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 - the headline line comes first
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_bench(backend: str) -> dict:
     import jax
 
@@ -673,6 +754,7 @@ def run_bench(backend: str) -> dict:
             "hbm_utilization_pct": roof["hbm_utilization_pct"],
         },
         "dataplane": _dataplane_stats(),
+        "stream": _stream_stats(eng, rows),
     }
     if payload["backend"] == "cpu":
         # A CPU fallback is NOT the framework's number — point at the

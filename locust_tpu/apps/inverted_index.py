@@ -462,7 +462,10 @@ class DistributedInvertedIndex:
 
         ckpt = None
         if checkpoint_dir is not None:
-            ckpt = ShardedCheckpoint(checkpoint_dir, fingerprint, sharding)
+            ckpt = ShardedCheckpoint(
+                checkpoint_dir, fingerprint, sharding,
+                async_writes=cfg.async_checkpoint,
+            )
             restored = ckpt.load()
             if restored is not None:
                 start_round, extras, acc, leftover = restored

@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p.add_argument("--checkpoint-every", type=positive_int, default=8,
                    help="blocks between snapshots (with --checkpoint-dir)")
+    p.add_argument("--sync-checkpoint", action="store_true",
+                   help="write snapshots synchronously inside the fold "
+                        "loop instead of on the bounded background writer "
+                        "(EngineConfig.async_checkpoint; identical on-disk "
+                        "format — async marks a generation and the writer "
+                        "copies/serializes off the hot path, latest-wins "
+                        "if the loop laps it; docs/DESIGN.md)")
     from locust_tpu.config import SORT_MODES
 
     p.add_argument("--sort-mode", choices=list(SORT_MODES),
@@ -213,6 +220,7 @@ def _run(args) -> int:
         key_width=args.key_width,
         emits_per_line=args.emits_per_line,
         sort_mode=args.sort_mode,
+        async_checkpoint=not args.sync_checkpoint,
     )
 
     # --trace / --profile-dir wire the hardening utils (SURVEY.md §5
@@ -332,6 +340,10 @@ def _run(args) -> int:
                     res = eng.run_fused(rows)
                 else:
                     res = eng.timed_run(rows)
+            if args.stream and res.stream is not None:
+                # Zero-stall executor accounting: backpressure stall +
+                # checkpoint mark/write stats (engine.run_stream).
+                print(f"[locust] stream: {res.stream}", file=sys.stderr)
             if not args.no_timing:
                 # The reference's per-stage report (README.md:72-88 format).
                 print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)

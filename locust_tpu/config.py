@@ -345,6 +345,30 @@ class EngineConfig:
     # resolves per backend at trace time: einsum on TPU, gather elsewhere.
     map_impl: str = "auto"
 
+    # --- zero-stall streaming executor knobs (docs/DESIGN.md) ---------
+    # Donate the fold accumulator into each per-block dispatch
+    # (jax.jit donate_argnums): XLA aliases the hash-table buffers
+    # input->output so the largest live array is updated in place
+    # instead of re-allocated per fold.  Applies to the per-block fold
+    # AND the one-dispatch lax.scan path; escape hatch for callers that
+    # hold references to a pre-fold accumulator.
+    donate_fold: bool = True
+
+    # Move checkpoint snapshots to a bounded background writer
+    # (io/snapshot.py): the fold loop only marks a generation (an
+    # on-device table copy, async) and the writer thread does the
+    # device->host copy + npz write + atomic rename off the critical
+    # path, latest-wins when the loop laps it.  False restores the
+    # synchronous in-loop save (identical on-disk format either way).
+    async_checkpoint: bool = True
+
+    # Reuse a ring of STREAM_DISPATCH_DEPTH+1 pre-allocated host staging
+    # buffers for run_stream's per-block pad+transfer instead of a fresh
+    # numpy allocation per block — allocation-free steady state, and the
+    # ring size is exactly what the bounded-inflight backpressure
+    # guarantees is no longer referenced by an in-flight fold.
+    stream_staging_ring: bool = True
+
     def __post_init__(self):
         if self.key_width <= 0 or self.key_width % 4 != 0:
             raise ValueError("key_width must be a positive multiple of 4 (uint32 lanes)")

@@ -42,6 +42,7 @@ import numpy as np
 from locust_tpu.config import DEFAULT_CONFIG, EngineConfig
 from locust_tpu.core import bytes_ops
 from locust_tpu.core.kv import KVBatch
+from locust_tpu.io.snapshot import AsyncCheckpointWriter, finalize_snapshot
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.process_stage import sort_and_compact
 from locust_tpu.ops.reduce_stage import (
@@ -103,6 +104,10 @@ class RunResult:
     truncated: bool           # True if distinct keys exceeded table capacity
     times: StageTimes
     combine: str = "sum"
+    # run_stream only: hot-loop stall accounting + checkpoint-writer
+    # stats (backpressure_stall_ms, ckpt.{mark_ms,written,skipped,
+    # max_lag,...}) — the numbers behind bench.py's "stream" sub-dict.
+    stream: dict | None = None
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Decode the table; re-merge hash-collision duplicates; key-sort.
@@ -121,6 +126,111 @@ class RunResult:
         from locust_tpu.io import serde
 
         serde.write_intermediate(self.to_host_pairs(), path, fmt)
+
+
+class _StagingRing:
+    """Reusable host staging buffers for the streaming fold.
+
+    ``slots`` pre-allocated ``[block_lines, width]`` uint8 buffers cycled
+    round-robin: each block is padded into the next slot
+    (normalize_round_chunk ``out=``) and handed straight to the device,
+    so steady-state staging allocates nothing — the flat-RSS contract's
+    allocation-free upgrade.
+
+    Reuse safety: jax's CPU backend aliases host numpy buffers zero-copy
+    at ``device_put``, so a slot must not be overwritten while its fold
+    is in flight.  ``run_stream``'s bounded-inflight backpressure syncs
+    the fold ``STREAM_DISPATCH_DEPTH`` blocks back before dispatching a
+    new one; with ``STREAM_DISPATCH_DEPTH + 1`` slots, the slot being
+    re-filled at block ``i`` was consumed by fold ``i - (slots)``, which
+    that sync already proved complete.
+    """
+
+    def __init__(self, slots: int, block_lines: int, width: int):
+        self._bufs = [
+            np.zeros((block_lines, width), np.uint8) for _ in range(slots)
+        ]
+        self._next = 0
+
+    def stage(self, chunk, block_lines: int, width: int) -> np.ndarray:
+        from locust_tpu.parallel.shuffle import normalize_round_chunk
+
+        buf = self._bufs[self._next]
+        self._next = (self._next + 1) % len(self._bufs)
+        return normalize_round_chunk(chunk, block_lines, width, out=buf)
+
+
+class _CheckpointPump:
+    """Per-run snapshot scheduler for the single-device engine.
+
+    Synchronous mode writes in the fold loop (the pre-existing
+    behavior); async mode (cfg.async_checkpoint) marks a generation —
+    an on-device copy of the accumulator, dispatched BEFORE the next
+    fold donates its buffers — and hands the serialize+rename to the
+    bounded background writer (io/snapshot.AsyncCheckpointWriter,
+    latest-wins if the loop laps it).  The on-disk format and atomic-
+    replace semantics are identical in both modes.
+    """
+
+    def __init__(self, engine: "MapReduceEngine", state_path: str,
+                 fingerprint: str, use_async: bool):
+        self._eng = engine
+        self._path = state_path
+        self._fp = fingerprint
+        self._writer = AsyncCheckpointWriter() if use_async else None
+        self.mark_ms = 0.0
+        self._sync_writes = 0
+
+    def mark(self, acc: KVBatch, next_block: int, overflow, max_distinct):
+        t0 = time.perf_counter()
+        if self._writer is None:
+            self._eng._save_state(
+                self._path, acc, next_block, overflow, max_distinct, self._fp
+            )
+            self._sync_writes += 1
+        else:
+            # Device-to-device copy (async dispatch, no host sync): the
+            # donated fold reuses acc's buffers next iteration, so the
+            # writer must snapshot a buffer the loop will never touch.
+            # The scalar counters are fresh eager arrays each fold and
+            # are never donated — holding references suffices.
+            snap = KVBatch(
+                key_lanes=jnp.copy(acc.key_lanes),
+                values=jnp.copy(acc.values),
+                valid=jnp.copy(acc.valid),
+            )
+            self._writer.submit(
+                next_block,
+                partial(
+                    self._eng._save_state, self._path, snap, next_block,
+                    overflow, max_distinct, self._fp,
+                ),
+            )
+        self.mark_ms += (time.perf_counter() - t0) * 1e3
+
+    def finish(self) -> float:
+        """Normal-path completion: block until the last marked generation
+        is durably renamed; re-raises writer errors.  Returns the wait ms
+        (the ONLY synchronous write cost the async mode keeps)."""
+        t0 = time.perf_counter()
+        if self._writer is not None:
+            self._writer.flush()
+        return (time.perf_counter() - t0) * 1e3
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+    def stats(self) -> dict:
+        out = {
+            "mode": "async" if self._writer is not None else "sync",
+            "mark_ms": round(self.mark_ms, 3),
+        }
+        if self._writer is not None:
+            out.update(self._writer.stats())
+        else:
+            out["written"] = self._sync_writes
+        return out
 
 
 class MapReduceEngine:
@@ -167,12 +277,15 @@ class MapReduceEngine:
             merged, distinct = fold_into(acc, kv, tsize, combine, mode)
             return merged, overflow, distinct
 
-        def scan_blocks(blocks: jax.Array):
+        def scan_blocks_into(acc0: KVBatch, blocks: jax.Array):
             """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.
 
             One device dispatch per corpus instead of per block — essential
             when dispatch latency is high (remote TPU tunnels) and the XLA-
             idiomatic way to loop without data-dependent Python control flow.
+            The init accumulator arrives as an ARGUMENT so the jit below
+            can donate it into the scan carry (cfg.donate_fold): even the
+            one-dispatch path allocates no second table.
             """
 
             def body(carry, blk):
@@ -184,16 +297,27 @@ class MapReduceEngine:
                     jnp.maximum(max_distinct, distinct),
                 ), None
 
-            init = (
-                KVBatch.empty(tsize, cfg.key_lanes),
-                jnp.int32(0),
-                jnp.int32(0),
-            )
+            init = (acc0, jnp.int32(0), jnp.int32(0))
             (acc, overflow, num), _ = jax.lax.scan(body, init, blocks)
             return acc, overflow, num
 
-        self._fold_block = jax.jit(fold_block)
-        self._scan_blocks = jax.jit(scan_blocks)
+        # Donated fold state (cfg.donate_fold): the accumulator table —
+        # the largest live array — is donated into every per-block
+        # dispatch and into the scan init, so XLA aliases its buffers
+        # input->output (updated in place, no per-fold re-allocation).
+        # Callers therefore must treat the acc they passed as consumed;
+        # every loop here rebinds it, and snapshot marks copy on device
+        # first (_CheckpointPump.mark).
+        donate = (0,) if cfg.donate_fold else ()
+        self._fold_block = jax.jit(fold_block, donate_argnums=donate)
+        self._scan_blocks_into = jax.jit(scan_blocks_into, donate_argnums=donate)
+        # The export/compile-check surface (__graft_entry__.entry, the
+        # TPU StableHLO lowering gates) keeps the one-argument signature.
+        self._scan_blocks = jax.jit(
+            lambda blocks: scan_blocks_into(
+                KVBatch.empty(tsize, cfg.key_lanes), blocks
+            )
+        )
 
         # Split stages for the timed path only.
         def merge_tables(acc: KVBatch, table: KVBatch, max_distinct: jax.Array):
@@ -205,7 +329,7 @@ class MapReduceEngine:
         self._map = jax.jit(lambda lines: map_fn(lines, cfg))
         self._process = jax.jit(partial(sort_and_compact, mode=mode))
         self._reduce = jax.jit(partial(segment_reduce, combine=combine))
-        self._merge = jax.jit(merge_tables)
+        self._merge = jax.jit(merge_tables, donate_argnums=donate)
         self._table_size = tsize
 
     # ---------------------------------------------------------------- ingest
@@ -263,7 +387,8 @@ class MapReduceEngine:
     def run_blocks(self, blocks: jax.Array) -> RunResult:
         """One-dispatch run over pre-staged ``[nblocks, block_lines, width]``."""
         t0 = time.perf_counter()
-        acc, overflow, num = self._scan_blocks(blocks)
+        acc0 = KVBatch.empty(self._table_size, self.cfg.key_lanes)
+        acc, overflow, num = self._scan_blocks_into(acc0, blocks)
         num = int(num)  # host sync: the scan (and everything before) is done
         total_ms = (time.perf_counter() - t0) * 1e3
         return self._finish(acc, num, int(overflow), StageTimes(0, total_ms, 0))
@@ -334,6 +459,13 @@ class MapReduceEngine:
         without reading it fully), snapshots land every ``every`` blocks
         exactly as in ``run_checkpointed``; a resume re-READS but does not
         re-process already-folded blocks.
+
+        Zero-stall executor (docs/DESIGN.md): the fold accumulator is
+        DONATED into each dispatch (updated in place), blocks stage
+        through a reusable host buffer ring instead of per-block
+        allocations, and snapshots ride the background writer — the hot
+        loop's only synchronous work is the bounded-inflight
+        backpressure.  Stall accounting lands in ``RunResult.stream``.
         """
         from locust_tpu.io.loader import prefetch_blocks
         from locust_tpu.parallel.shuffle import normalize_round_chunk
@@ -343,7 +475,7 @@ class MapReduceEngine:
         overflow = jnp.int32(0)
         max_distinct = jnp.int32(0)
         start_block = 0
-        state_path = None
+        pump = None
         if checkpoint_dir is not None:
             if every < 1:
                 raise ValueError(f"checkpoint every must be >= 1, got {every}")
@@ -360,7 +492,17 @@ class MapReduceEngine:
             start_block, overflow, max_distinct, acc = self._load_state(
                 state_path, fingerprint, acc
             )
+            pump = _CheckpointPump(
+                self, state_path, fingerprint, self.cfg.async_checkpoint
+            )
+        ring = (
+            _StagingRing(self.STREAM_DISPATCH_DEPTH + 1, bl, w)
+            if self.cfg.stream_staging_ring
+            else None
+        )
 
+        stall_ms = 0.0
+        flush_ms = 0.0
         t0 = time.perf_counter()
         # Bound the async dispatch depth: without a sync, the host loop
         # races ahead of the device and EVERY staged block stays
@@ -368,7 +510,9 @@ class MapReduceEngine:
         # size, which is exactly what a streaming fold must not do
         # (measured: +55MB at 16MB vs +110MB at 64MB before this bound).
         # Blocking on the fold K steps back keeps K blocks of pipeline
-        # overlap while releasing older staging buffers.
+        # overlap while releasing older staging buffers — and proves the
+        # staging ring's slot about to be re-filled is no longer read by
+        # any in-flight fold (_StagingRing).
         import collections as _collections
 
         inflight: _collections.deque = _collections.deque()
@@ -376,28 +520,57 @@ class MapReduceEngine:
         # advances nothing, writes no snapshot, and finishes with the
         # RESTORED counters instead of zeros.
         i = start_block - 1
-        for i, blk in enumerate(blocks):
-            if i < start_block:  # resume: re-read, don't re-fold
-                continue
-            blk = normalize_round_chunk(blk, bl, w)
-            acc, blk_overflow, distinct = self._fold_block(acc, jnp.asarray(blk))
-            overflow = overflow + blk_overflow
-            max_distinct = jnp.maximum(max_distinct, distinct)
-            inflight.append(blk_overflow)
-            if len(inflight) > self.STREAM_DISPATCH_DEPTH:
-                jax.block_until_ready(inflight.popleft())  # locust: noqa[R003] bounded-inflight backpressure: sync caps device queue depth, overlap stays STREAM_DISPATCH_DEPTH deep
-            if state_path is not None and (i + 1) % every == 0:
-                self._save_state(
-                    state_path, acc, i + 1, overflow, max_distinct, fingerprint
+        last_mark = start_block
+        try:
+            for i, blk in enumerate(blocks):
+                if i < start_block:  # resume: re-read, don't re-fold
+                    continue
+                blk = (
+                    ring.stage(blk, bl, w)
+                    if ring is not None
+                    else normalize_round_chunk(blk, bl, w)
                 )
-        if state_path is not None and i + 1 > start_block:
-            self._save_state(
-                state_path, acc, i + 1, overflow, max_distinct, fingerprint
-            )
+                acc, blk_overflow, distinct = self._fold_block(
+                    acc, jnp.asarray(blk)
+                )
+                overflow = overflow + blk_overflow
+                max_distinct = jnp.maximum(max_distinct, distinct)
+                inflight.append(blk_overflow)
+                if len(inflight) > self.STREAM_DISPATCH_DEPTH:
+                    t_sync = time.perf_counter()
+                    jax.block_until_ready(inflight.popleft())  # locust: noqa[R003] bounded-inflight backpressure: sync caps device queue depth, overlap stays STREAM_DISPATCH_DEPTH deep
+                    stall_ms += (time.perf_counter() - t_sync) * 1e3
+                if pump is not None and (i + 1) % every == 0:
+                    pump.mark(acc, i + 1, overflow, max_distinct)
+                    last_mark = i + 1
+            # Final-generation mark — only when folds ran past the last
+            # cadence mark (a cadence-aligned corpus otherwise writes
+            # its largest array twice back-to-back).
+            if pump is not None and i + 1 > last_mark:
+                pump.mark(acc, i + 1, overflow, max_distinct)
+            if pump is not None:
+                # The final generation must be durable before returning
+                # (resume contract); this is the async mode's only wait.
+                flush_ms = pump.finish()
+        finally:
+            if pump is not None:
+                pump.close()
         jax.block_until_ready(acc.key_lanes)
         total_ms = (time.perf_counter() - t0) * 1e3
+        stream = {
+            "blocks": max(0, i + 1 - start_block),
+            "staging_ring": ring is not None,
+            "donate_fold": self.cfg.donate_fold,
+            "backpressure_stall_ms": round(stall_ms, 3),
+            "total_ms": round(total_ms, 3),
+        }
+        if pump is not None:
+            stream["ckpt"] = dict(
+                pump.stats(), every=every, final_flush_ms=round(flush_ms, 3)
+            )
         return self._finish(
-            acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0)
+            acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0),
+            stream=stream,
         )
 
     def _load_state(self, state_path: str, fingerprint: str, acc: KVBatch):
@@ -414,10 +587,18 @@ class MapReduceEngine:
                         start_block = int(z["next_block"])
                         overflow = jnp.int32(int(z["overflow"]))
                         max_distinct = jnp.int32(int(z["max_distinct"]))
+                        # jnp.array(copy=True), NOT asarray: on CPU, jax
+                        # zero-copy aliases host numpy buffers, and the
+                        # first resumed fold DONATES the accumulator —
+                        # donating numpy-owned memory corrupts the heap
+                        # (XLA frees what it never allocated; observed as
+                        # nondeterministic segfaults under pytest).  The
+                        # copy puts the restored table in jax-owned
+                        # memory the donation machinery may reclaim.
                         acc = KVBatch(
-                            key_lanes=jnp.asarray(z["key_lanes"]),
-                            values=jnp.asarray(z["values"]),
-                            valid=jnp.asarray(z["valid"]),
+                            key_lanes=jnp.array(z["key_lanes"], copy=True),
+                            values=jnp.array(z["values"], copy=True),
+                            valid=jnp.array(z["valid"], copy=True),
                         )
                         logger.info(
                             "resuming from checkpoint at block %d (%s)",
@@ -449,7 +630,11 @@ class MapReduceEngine:
                     fingerprint) -> None:
         """One atomically-replaced npz: table + cursor + counters can never
         tear apart.  The tmp name keeps the .npz suffix (np.savez appends
-        it otherwise)."""
+        it otherwise).  Runs on the fold loop (sync mode) or the
+        background writer (cfg.async_checkpoint) — the np.asarray
+        conversions wait on the marked fold's readiness and copy
+        device->host, then finalize_snapshot publishes atomically
+        (io.ckpt_write / io.checkpoint chaos sites)."""
         tmp = state_path + ".tmp.npz"
         np.savez_compressed(
             tmp,
@@ -461,7 +646,7 @@ class MapReduceEngine:
             max_distinct=np.asarray(max_distinct),
             fingerprint=np.str_(fingerprint),
         )
-        os.replace(tmp, state_path)
+        finalize_snapshot(tmp, state_path, generation=int(next_block))
 
     # ---------------------------------------------------------- checkpointing
 
@@ -502,28 +687,35 @@ class MapReduceEngine:
             fingerprint,
             KVBatch.empty(self._table_size, self.cfg.key_lanes),
         )
+        pump = _CheckpointPump(
+            self, state_path, fingerprint, self.cfg.async_checkpoint
+        )
 
         t0 = time.perf_counter()
         i = start_block - 1
-        for i, blk in enumerate(self._blocks(rows)):
-            if i < start_block:
-                continue
-            acc, blk_overflow, distinct = self._fold_block(acc, blk)
-            overflow = overflow + blk_overflow
-            max_distinct = jnp.maximum(max_distinct, distinct)
-            if (i + 1) % every == 0:
-                self._save_state(
-                    state_path, acc, i + 1, overflow, max_distinct, fingerprint
-                )
-        self._save_state(
-            state_path, acc, i + 1, overflow, max_distinct, fingerprint
-        )
+        last_mark = start_block
+        try:
+            for i, blk in enumerate(self._blocks(rows)):
+                if i < start_block:
+                    continue
+                acc, blk_overflow, distinct = self._fold_block(acc, blk)
+                overflow = overflow + blk_overflow
+                max_distinct = jnp.maximum(max_distinct, distinct)
+                if (i + 1) % every == 0:
+                    pump.mark(acc, i + 1, overflow, max_distinct)
+                    last_mark = i + 1
+            if i + 1 > last_mark:  # skip the cadence-aligned double write
+                pump.mark(acc, i + 1, overflow, max_distinct)
+            pump.finish()  # final generation durable before returning
+        finally:
+            pump.close()
         total_ms = (time.perf_counter() - t0) * 1e3
         return self._finish(
             acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0)
         )
 
-    def _finish(self, acc, num_segments, overflow, times) -> RunResult:
+    def _finish(self, acc, num_segments, overflow, times,
+                stream: dict | None = None) -> RunResult:
         if os.environ.get("LOCUST_DEBUG_CHECKS"):
             # Opt-in invariant sweep on the result table (the sanitizer
             # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.
@@ -561,4 +753,5 @@ class MapReduceEngine:
             truncated=truncated,
             times=times,
             combine=self.combine,
+            stream=stream,
         )

@@ -1,1 +1,1 @@
-from locust_tpu.io import loader, serde  # noqa: F401
+from locust_tpu.io import loader, serde, snapshot  # noqa: F401

@@ -1,0 +1,227 @@
+"""Asynchronous, bounded checkpoint writing for the streaming tier.
+
+The reference's persistence story is synchronous by construction — "map
+wrote /tmp/out.txt, re-run reduce from it" (reference
+MapReduce/src/main.cu:428-441).  Our streaming folds used to inherit that
+shape: ``Engine._save_state`` and ``ShardedCheckpoint.snapshot`` did the
+device->host snapshot plus the compressed npz write INSIDE the fold
+loop, stalling the device pipeline once per checkpoint cadence.  This
+module is the tf.data-style fix (Murray et al., VLDB '21: keep the
+accelerator busy by moving host byte movement off the critical path):
+
+  * the hot loop only MARKS a generation — an on-device copy of the
+    accumulator (cheap, async) plus a closure that can serialize it;
+  * a single daemon writer thread waits on that specific fold's
+    readiness (the device->host copy inside the closure blocks until the
+    marked fold completed), serializes, and atomically renames;
+  * the queue is bounded to ONE pending generation, latest-wins: if the
+    loop laps the writer, intermediate generations are skipped — a
+    resume then re-reads (but never re-folds) a few more blocks, which
+    is exactly the durability/throughput trade a checkpoint cadence
+    already expresses.
+
+Crash consistency is unchanged relative to the synchronous writers: every
+snapshot still lands as one atomically-replaced npz (tmp write + fsync-
+free ``os.replace``, same as before), so the state file is always some
+COMPLETE generation; the ``io.ckpt_write`` fault site injects a writer
+crash between the tmp write and the rename (the new failure point the
+async path adds) and the chaos matrix (tests/test_faults.py) pins that
+the run's output stays byte-identical and a resume over the debris stays
+exact.
+
+Error discipline: a FaultInjected "crash" models the writer dying — the
+snapshot is abandoned (old generation survives; durability, not
+correctness) and the run continues.  Any OTHER writer exception (disk
+full, permission) is recorded and re-raised on the submitting thread at
+the next ``submit()``/``flush()`` — real failures stay loud, just like
+the synchronous path, at most one cadence late.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from locust_tpu.utils import faultplan
+
+logger = logging.getLogger("locust_tpu")
+
+
+def finalize_snapshot(tmp: str, path: str, prev_path: str | None = None,
+                      generation: int | None = None) -> None:
+    """Publish a fully-written ``tmp`` snapshot at ``path`` atomically.
+
+    The ONE copy of the publish protocol shared by the single-device
+    engine and ``ShardedCheckpoint``: optional previous-generation
+    rotation, the ``io.ckpt_write`` chaos hook at the new async failure
+    point (crash/delay between serialization and rename), the atomic
+    ``os.replace``, then the pre-existing ``io.checkpoint`` damage hook
+    on the published file.  A "crash" fault leaves ``tmp`` behind and
+    ``path`` at its previous generation — exactly the debris a writer
+    thread dying at that instant would leave.
+    """
+    rule = faultplan.fire("io.ckpt_write", path=path, generation=generation)
+    if rule is not None:
+        if rule.action == "delay" and rule.delay_s > 0:
+            time.sleep(rule.delay_s)
+        elif rule.action == "crash":
+            raise faultplan.FaultCrash(
+                f"[faultplan] injected checkpoint-writer crash before "
+                f"rename of {path} (generation {generation})"
+            )
+    if prev_path is not None and os.path.exists(path):
+        os.replace(path, prev_path)
+    os.replace(tmp, path)
+    # Post-publish bit-rot/truncation chaos (no-op without an active
+    # plan) — loaders must validate and fall back.
+    faultplan.damage_file("io.checkpoint", path)
+
+
+class AsyncCheckpointWriter:
+    """Bounded background snapshot writer, one pending generation deep.
+
+    ``submit(generation, write_fn)`` replaces any still-pending
+    generation (latest-wins) and returns immediately; the daemon thread
+    runs ``write_fn()`` — which owns waiting for device readiness, the
+    device->host copy, serialization, and the atomic rename — strictly
+    serially, so two generations can never interleave their tmp files.
+    ``flush()`` blocks until nothing is pending or in flight and
+    re-raises any recorded writer error; ``close()`` flushes best-effort
+    and joins the thread, never raising (safe in ``finally`` blocks).
+
+    Stats (all under the one lock): ``submitted`` marks, ``written``
+    snapshots, ``skipped`` generations replaced while pending (the loop
+    lapped the writer), ``abandoned`` injected-crash writes, and
+    ``max_lag`` — measured at WRITE COMPLETION as how many generations
+    the just-published snapshot trails the newest mark (0 = the writer
+    is keeping up; positive = the loop lapped it by that many blocks) —
+    the "checkpoint lag" the bench reports.
+    """
+
+    def __init__(self, name: str = "ckpt-writer"):
+        self._cond = threading.Condition()
+        self._pending: tuple[int, object] | None = None
+        self._busy = False
+        self._closed = False
+        self._error: BaseException | None = None
+        self._submitted = 0
+        self._written = 0
+        self._skipped = 0
+        self._abandoned = 0
+        self._latest_gen = 0
+        self._max_lag = 0
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ api
+
+    def submit(self, generation: int, write_fn) -> None:
+        """Mark ``generation`` for writing; replaces any pending mark."""
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._pending is not None:
+                self._skipped += 1
+            self._pending = (generation, write_fn)
+            self._submitted += 1
+            self._latest_gen = max(self._latest_gen, generation)
+            self._cond.notify_all()
+
+    def flush(self, raise_errors: bool = True,
+              timeout: float | None = None) -> bool:
+        """Wait until the writer is idle (or ``timeout`` seconds passed);
+        surface any recorded error.  Returns True if the writer is idle."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._busy:
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                self._cond.wait(timeout=0.5)
+            if raise_errors and self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return True
+
+    def close(self) -> None:
+        """Flush best-effort (BOUNDED — a write_fn wedged on a dead
+        device link must not turn the caller's ``finally`` into a hang)
+        and stop the thread.  Never raises; on timeout the daemon thread
+        is abandoned mid-write (the tmp-then-rename protocol means the
+        state file still holds a complete generation)."""
+        try:
+            if not self.flush(raise_errors=False, timeout=30.0):
+                logger.warning(
+                    "async checkpoint writer still busy at close; "
+                    "abandoning the in-flight write (daemon thread)"
+                )
+        except Exception:  # pragma: no cover - flush never raises here
+            pass
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "submitted": self._submitted,
+                "written": self._written,
+                "skipped": self._skipped,
+                "abandoned": self._abandoned,
+                "max_lag": self._max_lag,
+            }
+
+    # --------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None and self._closed:
+                    return
+                generation, fn = self._pending
+                self._pending = None
+                self._busy = True
+                self._cond.notify_all()
+            abandoned = False
+            error = None
+            try:
+                fn()
+            except faultplan.FaultInjected as e:
+                # An injected writer crash: the snapshot is abandoned and
+                # the previous generation survives on disk — durability
+                # lost for one cadence, correctness untouched.
+                abandoned = True
+                logger.warning(
+                    "checkpoint writer crash injected at generation %d "
+                    "(%s); snapshot abandoned", generation, e,
+                )
+            except BaseException as e:  # noqa: BLE001 - relayed to submitter
+                error = e
+                logger.warning(
+                    "async checkpoint write failed at generation %d "
+                    "(%s: %s)", generation, type(e).__name__, e,
+                )
+            with self._cond:
+                self._busy = False
+                if abandoned:
+                    self._abandoned += 1
+                elif error is not None:
+                    self._error = error
+                else:
+                    self._written += 1
+                    # Lag at publish time: how far the newest mark has
+                    # run ahead of the generation that just became
+                    # durable.  0 for a writer that keeps up.
+                    self._max_lag = max(
+                        self._max_lag, self._latest_gen - generation
+                    )
+                self._cond.notify_all()

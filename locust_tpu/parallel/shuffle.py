@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 from locust_tpu.config import HASHT_FAMILY, EngineConfig
 from locust_tpu.core import packing
 from locust_tpu.core.kv import KVBatch
+from locust_tpu.io.snapshot import AsyncCheckpointWriter, finalize_snapshot
 from locust_tpu.ops.map_stage import wordcount_map
 from locust_tpu.ops.hash_table import fold_into, reduce_into
 from locust_tpu.parallel.mesh import DATA_AXIS, compat_shard_map
@@ -58,13 +59,20 @@ def sized_bins(total_rows: int, n_bins: int, skew_factor: float) -> int:
     )
 
 
-def normalize_round_chunk(chunk, lpr: int, width: int):
+def normalize_round_chunk(chunk, lpr: int, width: int, out=None):
     """Validate + zero-pad one round's host chunk to ``[lpr, width]``.
 
     The single copy of the chunk contract shared by every round loop
     (flat/hierarchical engines, inverted index): wider-than-config rows
     are a caller error (silently slicing them would drop tokens), more
     rows than a round holds likewise; short/narrow chunks zero-pad.
+
+    ``out`` (a caller-owned ``[lpr, width]`` uint8 buffer) makes the
+    normalization allocation-free: the chunk is copied in and the
+    remainder zeroed, and ``out`` is returned — the engine's staging
+    ring (engine.run_stream) feeds these straight into ``device_put``,
+    so the caller must not touch the buffer again until the consuming
+    dispatch completed (jax on CPU aliases host buffers zero-copy).
     """
     import numpy as np
 
@@ -82,6 +90,17 @@ def normalize_round_chunk(chunk, lpr: int, width: int):
             f"capacity of {lpr} (engine block_lines / mesh lines_per_round);"
             " size stream blocks to match"
         )
+    if out is not None:
+        if out.shape != (lpr, width) or out.dtype != np.uint8:
+            raise ValueError(
+                f"out buffer must be uint8 [{lpr}, {width}], got "
+                f"{out.dtype} {out.shape}"
+            )
+        n, w = chunk.shape
+        out[:n, :w] = chunk
+        out[n:, :] = 0
+        out[:n, w:] = 0
+        return out
     if chunk.shape[0] < lpr or chunk.shape[1] < width:
         padded = np.zeros((lpr, width), np.uint8)
         padded[: chunk.shape[0], : chunk.shape[1]] = chunk
@@ -129,6 +148,24 @@ class ShardedCheckpoint:
     it never crashes the run and never resumes wrong state.  Chaos
     coverage: tests/test_faults.py corrupts snapshots both directly and
     via the ``io.checkpoint`` fault site.
+
+    Asynchronous writes (``async_writes=True``, wired from
+    ``cfg.async_checkpoint``): the round loop hands the snapshot to the
+    bounded background writer (io/snapshot.AsyncCheckpointWriter,
+    latest-wins when lapped) instead of stalling on the device->host
+    gather + compressed npz write; the writer gathers lazily (the device
+    buffers behind a round's tables stay valid — mesh folds are not
+    donated).  SINGLE-PROCESS ONLY: on multi-process pods the request is
+    downgraded to synchronous writes, for two reasons — the gather is a
+    collective (process_allgather) that must issue on the main thread in
+    round order on every process, and latest-wins writers are PER
+    PROCESS, so under load skew they would publish DIFFERENT generations
+    per process and a resume would start processes at different rounds
+    (collective deadlock).  The synchronous path keeps every process
+    writing every cadence in round-loop lockstep.  The on-disk format,
+    checksum, ``.prev`` rotation and atomic replace are identical in
+    both modes; the owning loop (drive_checkpointed_rounds) flushes
+    before returning so the final generation is always durable.
     """
 
     _RESERVED = (
@@ -137,7 +174,8 @@ class ShardedCheckpoint:
         "left_key_lanes", "left_values", "left_valid",
     )
 
-    def __init__(self, checkpoint_dir: str, fingerprint: str, sharding):
+    def __init__(self, checkpoint_dir: str, fingerprint: str, sharding,
+                 async_writes: bool = False):
         import os
 
         os.makedirs(checkpoint_dir, exist_ok=True)
@@ -147,6 +185,11 @@ class ShardedCheckpoint:
         self.prev_path = self.path + ".prev.npz"
         self.fingerprint = fingerprint
         self.sharding = sharding
+        self._writer = (
+            AsyncCheckpointWriter(name="sharded-ckpt-writer")
+            if async_writes and jax.process_count() == 1
+            else None
+        )
 
     def load(self):
         """Returns ``(start_round, extras, acc, leftover)`` from the newest
@@ -209,11 +252,37 @@ class ShardedCheckpoint:
     def snapshot(self, next_round: int, acc, leftover, **extras) -> None:
         """One atomically-replaced npz: table, backlog, cursor and
         counters can never tear apart.  The outgoing generation survives
-        as ``.prev.npz`` so one corrupted write never strands the run."""
-        import os
+        as ``.prev.npz`` so one corrupted write never strands the run.
+        With ``async_writes`` the work rides the background writer (see
+        class docstring for the multi-process collective caveat)."""
+        from functools import partial
 
-        acc_h = _gather_batch_host(acc)
-        left_h = _gather_batch_host(leftover)
+        if self._writer is None:
+            self._write(
+                next_round, _gather_batch_host(acc),
+                _gather_batch_host(leftover), extras,
+            )
+            return
+        # Single-process by construction (__init__ downgrades pods to
+        # sync).  Mesh folds are not donated, so this round's device
+        # buffers stay valid while the loop moves on: the writer gathers
+        # lazily (device_get waits on the round's readiness off the hot
+        # loop).
+        self._writer.submit(
+            next_round,
+            partial(
+                self._gather_and_write, next_round, acc, leftover, extras
+            ),
+        )
+
+    def _gather_and_write(self, next_round, acc, leftover, extras) -> None:
+        self._write(
+            next_round, _gather_batch_host(acc), _gather_batch_host(leftover),
+            extras,
+        )
+
+    def _write(self, next_round, acc_h: KVBatch, left_h: KVBatch,
+               extras: dict) -> None:
         payload = dict(
             acc_key_lanes=acc_h.key_lanes,
             acc_values=acc_h.values,
@@ -231,14 +300,26 @@ class ShardedCheckpoint:
             checksum=np.str_(checkpoint_digest(payload)),
             **payload,
         )
-        if os.path.exists(self.path):
-            os.replace(self.path, self.prev_path)
-        os.replace(tmp, self.path)
-        # Chaos: io.checkpoint corruption/truncation of the just-written
-        # snapshot (no-op without an active plan) — load() must fall back.
-        from locust_tpu.utils import faultplan
+        # Rotation + io.ckpt_write chaos hook + atomic replace +
+        # io.checkpoint damage hook, shared with the engine's writer.
+        finalize_snapshot(
+            tmp, self.path, prev_path=self.prev_path, generation=next_round
+        )
 
-        faultplan.damage_file("io.checkpoint", self.path)
+    def flush(self) -> None:
+        """Wait for the last submitted generation to land durably;
+        re-raises writer errors.  No-op in synchronous mode."""
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self) -> None:
+        """Stop the background writer (best-effort flush, never raises).
+        Safe in ``finally``; no-op in synchronous mode."""
+        if self._writer is not None:
+            self._writer.close()
+
+    def writer_stats(self) -> dict | None:
+        return None if self._writer is None else self._writer.stats()
 
 
 def stream_checkpoint_fingerprint(
@@ -269,27 +350,36 @@ def drive_checkpointed_rounds(
     """The loop half of the snapshot protocol, one copy for every round
     engine: resume-skip of already-folded rounds, stats flush BEFORE each
     snapshot (snapshots must persist correct counters), the snapshot
-    cadence, and the final-snapshot rule (only when rounds ran past the
-    last snapshot).  ``body(chunk)`` folds one round and pushes its stats;
-    a body that raises leaves the last snapshot intact (no stale state).
+    cadence, the final-snapshot rule (only when rounds ran past the
+    last snapshot), and the async-writer finalization — flush (surface
+    writer errors, make the final generation durable) on the normal
+    path, close in ``finally`` so the writer thread never outlives the
+    run.  ``body(chunk)`` folds one round and pushes its stats; a body
+    that raises leaves the last snapshot intact (no stale state).
     """
     if checkpoint_every < 1:
         raise ValueError(
             f"checkpoint_every must be >= 1, got {checkpoint_every}"
         )
     last_snapshot = nrounds = start_round
-    for r, chunk in enumerate(chunk_iter):
-        if r < start_round:  # resume: re-read, don't re-fold
-            continue
-        nrounds = r + 1
-        body(chunk)
-        if ckpt is not None and (r + 1) % checkpoint_every == 0:
-            round_stats.flush()
-            snapshot(r + 1)
-            last_snapshot = r + 1
-    round_stats.flush()
-    if ckpt is not None and last_snapshot != nrounds:
-        snapshot(nrounds)
+    try:
+        for r, chunk in enumerate(chunk_iter):
+            if r < start_round:  # resume: re-read, don't re-fold
+                continue
+            nrounds = r + 1
+            body(chunk)
+            if ckpt is not None and (r + 1) % checkpoint_every == 0:
+                round_stats.flush()
+                snapshot(r + 1)
+                last_snapshot = r + 1
+        round_stats.flush()
+        if ckpt is not None and last_snapshot != nrounds:
+            snapshot(nrounds)
+        if ckpt is not None:
+            ckpt.flush()
+    finally:
+        if ckpt is not None:
+            ckpt.close()
 
 
 class RoundStats:
@@ -878,7 +968,10 @@ class DistributedMapReduce:
 
         ckpt = None
         if checkpoint_dir is not None:
-            ckpt = ShardedCheckpoint(checkpoint_dir, fingerprint, sharding)
+            ckpt = ShardedCheckpoint(
+                checkpoint_dir, fingerprint, sharding,
+                async_writes=self.cfg.async_checkpoint,
+            )
             restored = ckpt.load()
             if restored is not None:
                 start_round, extras, acc, leftover = restored

@@ -33,6 +33,9 @@ a chaos plan must fail LOUDLY at parse time, not silently inject nothing):
   io.chunk         encoded (possibly compressed) fetch payload about to be
                    framed (docs/DATAPLANE.md)     ctx: path, offset, port, enc
   io.checkpoint    engine snapshot just written   ctx: path
+  io.ckpt_write    checkpoint writer between the fully-written tmp
+                   snapshot and its atomic rename (io/snapshot.py;
+                   docs/FAULTS.md)               ctx: path, generation
 
 Determinism: rule bookkeeping is pure counting (``after`` skips, ``times``
 caps), and the probabilistic gate + byte mutations derive from
@@ -70,6 +73,14 @@ SITES = {
     # from rpc.frame, which mangles the framed wire bytes.
     "io.chunk": ("corrupt", "truncate", "delay"),
     "io.checkpoint": ("corrupt", "truncate"),
+    # The async checkpoint writer's publish point (io/snapshot.py
+    # finalize_snapshot): "crash" dies between the fully-written tmp
+    # snapshot and its atomic rename (tmp debris, previous generation
+    # survives — on the background writer the run continues and the
+    # snapshot is abandoned; on a synchronous save the loop thread IS
+    # the writer, so it propagates as a structured error); "delay"
+    # stalls the writer so the hot loop laps it (latest-wins skips).
+    "io.ckpt_write": ("crash", "delay"),
 }
 
 _RULE_KEYS = {"site", "action", "match", "times", "after", "prob", "delay_s"}

@@ -157,13 +157,23 @@ def commit_ledger() -> None:
     captures); retry briefly on index-lock races with the interactive
     session's own commits.  Raw capture trees (a killed phase_profile
     leaves its multi-MB prof_dir behind — the gzip+cleanup only runs on
-    success) are never staged: only the *.xplane.pb.gz files the
-    profiler phase finalizes."""
+    success) are never staged: only *.xplane.pb.gz files that a
+    committed-able ledger row actually CLAIMS (its ``xplane`` field) —
+    an orphan gz with no row is exactly how a CPU-origin capture once
+    landed as TPU evidence (VERDICT r5 weak #1), so orphans are left
+    uncommitted for a human to inspect."""
     import glob
 
-    paths = [LEDGER] + sorted(
-        glob.glob(os.path.join(PROFILES, "*.xplane.pb.gz"))
-    )
+    ledgered = {
+        os.path.basename(str(r.get("xplane")))
+        for r in ledger_rows()
+        if r.get("xplane")
+    }
+    paths = [LEDGER] + [
+        p
+        for p in sorted(glob.glob(os.path.join(PROFILES, "*.xplane.pb.gz")))
+        if os.path.basename(p) in ledgered
+    ]
     diff = subprocess.run(
         ["git", "status", "--porcelain", "--"] + paths,
         cwd=REPO, capture_output=True, text=True,

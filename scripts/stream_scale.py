@@ -154,11 +154,13 @@ def main() -> int:
     wall = time.perf_counter() - t0
     rss_peak = _rss_mb()
 
-    # The fold's expected working set: staged blocks (dispatch depth +
-    # prefetch) + the device table mirrored at sync + host block assembly.
+    # The fold's expected working set: the staging ring
+    # (STREAM_DISPATCH_DEPTH + 1 reusable slots — the in-flight blocks
+    # ARE ring slots now) + prefetch-held source blocks + the device
+    # table mirrored at sync + host block assembly.
     block_mb = args.block_lines * d.line_width / 1e6
     expected_mb = (
-        block_mb * (MapReduceEngine.STREAM_DISPATCH_DEPTH + 2)
+        block_mb * (MapReduceEngine.STREAM_DISPATCH_DEPTH + 1 + 2)
         + eng.cfg.resolved_table_size * (kw + 8) / 1e6
     )
 
@@ -189,6 +191,7 @@ def main() -> int:
         "peak_rss_mb": round(rss_peak, 0),
         "fold_delta_mb": round(rss_peak - rss_before_fold, 0),
         "expected_working_set_mb": round(expected_mb, 1),
+        "stream": res.stream,  # zero-stall executor accounting
         "token_oracle_match": match,
         "note": "corpus pre-generated by a separate process; rss fields "
                 "are the measuring process only",

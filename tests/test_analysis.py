@@ -296,6 +296,8 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/distributor/worker.py",
         "locust_tpu/distributor/master.py",
         "locust_tpu/parallel/shuffle.py",
+        "locust_tpu/io/snapshot.py",  # hooks io.ckpt_write + io.checkpoint
+        "locust_tpu/engine.py",       # hooks via finalize_snapshot call
         "tests/test_faults.py",
         "docs/FAULTS.md",
     ):

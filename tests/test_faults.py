@@ -619,11 +619,20 @@ def _mesh_cfg():
 
 
 def _mesh_fixture(tmp_path):
-    """A mesh engine mid-corpus with two checkpoint generations on disk."""
+    """A mesh engine mid-corpus with two checkpoint generations on disk.
+
+    Pinned to SYNCHRONOUS snapshots: the fixture's assertions depend on
+    exactly one snapshot per completed round (two generations on disk
+    after two rounds), and the async writer's latest-wins contract makes
+    that count timing-dependent.  The async path has its own chaos
+    coverage below (io.ckpt_write) and rides the default config in
+    test_chaos_checkpoint_fault_site_never_wrong_counts."""
+    import dataclasses
+
     from locust_tpu.parallel.mesh import make_mesh
     from locust_tpu.parallel.shuffle import DistributedMapReduce
 
-    cfg = _mesh_cfg()
+    cfg = dataclasses.replace(_mesh_cfg(), async_checkpoint=False)
     lines = [b"alpha beta", b"beta gamma", b"alpha delta epsilon"] * 40
     rows = bytes_ops.strings_to_rows(lines, cfg.line_width)
     mesh = make_mesh(8)
@@ -752,6 +761,149 @@ def test_chaos_checkpoint_fault_site_never_wrong_counts(tmp_path):
     assert p.rules[0].fired >= 1
     # resume over the damaged snapshots: falls back (possibly to fresh)
     res2 = dmr.run(rows, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert dict(res2.to_host_pairs()) == want
+
+
+# ------------------------------------------- async checkpoint writer chaos
+#
+# The io.ckpt_write site fires between the fully-written tmp snapshot and
+# its atomic rename — the one new failure point the background writer
+# adds (io/snapshot.finalize_snapshot).  Contract: output byte-identical
+# (a lost snapshot is lost durability, never lost correctness) or, on the
+# synchronous path where the fold loop IS the writer, a structured error.
+
+
+def _stream_engine(block_lines=4, **cfg_kw):
+    from locust_tpu.engine import MapReduceEngine
+
+    cfg = EngineConfig(
+        block_lines=block_lines, line_width=64, emits_per_line=8, **cfg_kw
+    )
+    return MapReduceEngine(cfg), cfg
+
+
+def _stream_corpus(tmp_path, reps=8):
+    p = tmp_path / "stream_corpus.txt"
+    if not p.exists():
+        p.write_bytes(CORPUS * reps)
+    return str(p)
+
+
+def _stream_blocks(path, cfg):
+    from locust_tpu.io.loader import StreamingCorpus
+
+    return StreamingCorpus(path, cfg.line_width, cfg.block_lines)
+
+
+def test_chaos_async_ckpt_writer_crash_before_rename(tmp_path):
+    """An injected writer crash between tmp write and rename: the
+    snapshot is abandoned (previous generation survives), the run's
+    output is byte-identical, and a resume over the debris is exact."""
+    eng, cfg = _stream_engine()
+    path = _stream_corpus(tmp_path)
+    want = dict(
+        eng.run_stream(_stream_blocks(path, cfg)).to_host_pairs()
+    )
+    ck = str(tmp_path / "async_crash_ck")
+    fp = _stream_blocks(path, cfg).fingerprint()
+    p = plan([{"site": "io.ckpt_write", "action": "crash", "times": 1}])
+    with faultplan.active_plan(p):
+        res = eng.run_stream(
+            _stream_blocks(path, cfg), checkpoint_dir=ck, every=1,
+            fingerprint=fp,
+        )
+    assert dict(res.to_host_pairs()) == want
+    assert p.rules[0].fired == 1
+    assert res.stream["ckpt"]["mode"] == "async"
+    assert res.stream["ckpt"]["abandoned"] == 1
+    # Resume over whatever generation survived: exact, no re-fold drift.
+    res2 = eng.run_stream(
+        _stream_blocks(path, cfg), checkpoint_dir=ck, every=1, fingerprint=fp
+    )
+    assert dict(res2.to_host_pairs()) == want
+
+
+def test_chaos_async_ckpt_delayed_writer_lapped_generation(tmp_path):
+    """A slow writer (injected delay on every publish): the fold loop
+    laps it, latest-wins skips intermediate generations, the final
+    generation still lands at flush, and output/resume stay exact."""
+    eng, cfg = _stream_engine()
+    path = _stream_corpus(tmp_path)
+    want = dict(
+        eng.run_stream(_stream_blocks(path, cfg)).to_host_pairs()
+    )
+    ck = str(tmp_path / "async_delay_ck")
+    fp = _stream_blocks(path, cfg).fingerprint()
+    p = plan([{"site": "io.ckpt_write", "action": "delay",
+               "delay_s": 0.25}])  # unlimited: every publish stalls
+    with faultplan.active_plan(p):
+        res = eng.run_stream(
+            _stream_blocks(path, cfg), checkpoint_dir=ck, every=1,
+            fingerprint=fp,
+        )
+    assert dict(res.to_host_pairs()) == want
+    assert p.rules[0].fired >= 1
+    cks = res.stream["ckpt"]
+    assert cks["skipped"] >= 1, "the loop should have lapped the writer"
+    assert cks["max_lag"] >= 2
+    # The FINAL generation was flushed before return: a resume with an
+    # exhausted iterator reports the restored (complete) counters.
+    res2 = eng.run_stream(
+        iter([]), checkpoint_dir=ck, every=1, fingerprint=fp
+    )
+    assert dict(res2.to_host_pairs()) == want
+    assert res2.num_segments == res.num_segments
+
+
+def test_chaos_sync_ckpt_write_crash_structured_error(tmp_path):
+    """Synchronous mode (cfg.async_checkpoint=False): the fold loop IS
+    the writer, so an injected crash at the publish point surfaces as a
+    structured FaultInjected error — the 'or error' arm — and a later
+    clean run resumes exactly from the surviving generation."""
+    eng, cfg = _stream_engine(async_checkpoint=False)
+    path = _stream_corpus(tmp_path)
+    want = dict(
+        eng.run_stream(_stream_blocks(path, cfg)).to_host_pairs()
+    )
+    ck = str(tmp_path / "sync_crash_ck")
+    fp = _stream_blocks(path, cfg).fingerprint()
+    p = plan([{"site": "io.ckpt_write", "action": "crash", "times": 1}])
+    with faultplan.active_plan(p):
+        with pytest.raises(faultplan.FaultInjected):
+            eng.run_stream(
+                _stream_blocks(path, cfg), checkpoint_dir=ck, every=1,
+                fingerprint=fp,
+            )
+    assert p.rules[0].fired == 1
+    res = eng.run_stream(
+        _stream_blocks(path, cfg), checkpoint_dir=ck, every=1, fingerprint=fp
+    )
+    assert dict(res.to_host_pairs()) == want
+
+
+def test_chaos_engine_stream_checkpoint_damage_clean_restart(tmp_path):
+    """io.checkpoint damage on EVERY published engine snapshot (fired on
+    the background writer thread): the streaming run's output is
+    unaffected and a resume over the damaged state costs a clean fresh
+    start, never wrong counts."""
+    eng, cfg = _stream_engine()
+    path = _stream_corpus(tmp_path)
+    want = dict(
+        eng.run_stream(_stream_blocks(path, cfg)).to_host_pairs()
+    )
+    ck = str(tmp_path / "damage_ck")
+    fp = _stream_blocks(path, cfg).fingerprint()
+    p = plan([{"site": "io.checkpoint", "action": "truncate"}])
+    with faultplan.active_plan(p):
+        res = eng.run_stream(
+            _stream_blocks(path, cfg), checkpoint_dir=ck, every=1,
+            fingerprint=fp,
+        )
+    assert dict(res.to_host_pairs()) == want
+    assert p.rules[0].fired >= 1
+    res2 = eng.run_stream(
+        _stream_blocks(path, cfg), checkpoint_dir=ck, every=1, fingerprint=fp
+    )
     assert dict(res2.to_host_pairs()) == want
 
 
