@@ -615,6 +615,16 @@ def run_bench(backend: str) -> dict:
     from locust_tpu.config import EngineConfig
     from locust_tpu.engine import MapReduceEngine
 
+    # Opt-in telemetry (LOCUST_BENCH_OBS=1): spans/metrics from the
+    # streaming sub-bench land in an "obs" sub-dict of the one JSON line.
+    # Default OFF — the headline number must ride the zero-overhead no-op
+    # path (tests/test_obs.py pins it).
+    obs_on = os.environ.get("LOCUST_BENCH_OBS") == "1"
+    if obs_on:
+        from locust_tpu import obs
+
+        obs.enable(process="bench")
+
     target = TARGET_BYTES if backend == "tpu" else CPU_TARGET_BYTES
     lines = load_corpus(target)
     corpus_bytes = sum(len(ln) + 1 for ln in lines)
@@ -756,6 +766,10 @@ def run_bench(backend: str) -> dict:
         "dataplane": _dataplane_stats(),
         "stream": _stream_stats(eng, rows),
     }
+    if obs_on:
+        from locust_tpu import obs
+
+        payload["obs"] = obs.summary()
     if payload["backend"] == "cpu":
         # A CPU fallback is NOT the framework's number — point at the
         # committed TPU evidence so the driver-captured line is

@@ -1,4 +1,4 @@
-"""The closed rule registry (R001–R008) — itself anti-drift-checked:
+"""The closed rule registry (R001–R009) — itself anti-drift-checked:
 ``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
 pins that every registered rule has firing + silent fixture coverage."""
 
@@ -13,6 +13,7 @@ from locust_tpu.analysis.rules_hygiene import (
     SubprocessEnvRule,
     TrackedArtifactRule,
 )
+from locust_tpu.analysis.rules_telemetry import TelemetryRegistryRule
 from locust_tpu.analysis.rules_threads import ThreadSharedStateRule
 from locust_tpu.analysis.rules_traced import (
     HostSyncInLoopRule,
@@ -28,6 +29,7 @@ _RULE_CLASSES = (
     SubprocessEnvRule,          # R006
     BenchContractRule,          # R007
     TrackedArtifactRule,        # R008
+    TelemetryRegistryRule,      # R009
 )
 
 

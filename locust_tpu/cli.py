@@ -28,6 +28,8 @@ import sys
 
 import numpy as np
 
+from locust_tpu import obs  # jax-free; zero-overhead unless --trace-out
+
 STAGE_SINGLE, STAGE_MAP, STAGE_REDUCE = 0, 1, 2
 DEFAULT_INTERMEDIATE = "/tmp/out.txt"  # reference path, main.cu:428
 
@@ -126,6 +128,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", action="store_true",
                    help="print a wall-clock span report (load/run/output) "
                         "on stderr in addition to the stage report")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="structured telemetry (locust_tpu.obs): record "
+                        "the run's spans/events/metrics and export a "
+                        "Chrome-trace/Perfetto JSON timeline to FILE "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--fault-plan", default=None,
                    help="chaos-test fault injection plan: JSON text or a "
                         "path to a JSON file (also $LOCUST_FAULT_PLAN); "
@@ -148,11 +155,25 @@ def main(argv=None) -> int:
     if argv and argv[0] in SUBCOMMANDS:
         return cli_apps.main(argv[0], argv[1:])
     args = build_parser().parse_args(argv)
+    if args.trace_out:
+        obs.enable(process="cli")
     try:
         return _run(args)
     except OSError as e:
         print(f"mapreduce: error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if args.trace_out:
+            # Telemetry must not take down (or re-color) the run: an
+            # unwritable trace path is a warning, never the exit status.
+            try:
+                obs.export(args.trace_out)
+                print(f"[locust] trace written to {args.trace_out}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"[locust] trace export to {args.trace_out} "
+                      f"failed: {e}", file=sys.stderr)
+            obs.disable()
 
 
 def _run(args) -> int:
@@ -247,7 +268,7 @@ def _run(args) -> int:
     if args.auto_caps and args.stage in (STAGE_SINGLE, STAGE_MAP):
         import dataclasses
 
-        with timer.span("load"):
+        with timer.span("load"), obs.span("cli.load"):
             if args.stream:
                 # Bounded-memory measuring pass: the file is read twice
                 # (measure, then run) but never materialized — the caps
@@ -302,7 +323,7 @@ def _run(args) -> int:
 
     if args.stage in (STAGE_SINGLE, STAGE_MAP):
         with prof:
-            with timer.span("load"):
+            with timer.span("load"), obs.span("cli.load"):
                 if args.stream:
                     rows = None
                     stream = loader.StreamingCorpus(
@@ -321,7 +342,7 @@ def _run(args) -> int:
                         )
                     )
                     print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
-            with timer.span("run"):
+            with timer.span("run"), obs.span("cli.run"):
                 # Each run method syncs internally, so the span is accurate.
                 if args.stream:
                     kw = {}
@@ -345,10 +366,17 @@ def _run(args) -> int:
                 # checkpoint mark/write stats (engine.run_stream).
                 print(f"[locust] stream: {res.stream}", file=sys.stderr)
             if not args.no_timing:
-                # The reference's per-stage report (README.md:72-88 format).
-                print(f"Map stage:     {res.times.map_ms:10.3f} ms", file=sys.stderr)
-                print(f"Process stage: {res.times.process_ms:10.3f} ms", file=sys.stderr)
-                print(f"Reduce stage:  {res.times.reduce_ms:10.3f} ms", file=sys.stderr)
+                # The reference's per-stage report (README.md:72-88
+                # stages), through SpanTimer.report(): stable descending
+                # sort + percent-of-total (format pinned by
+                # tests/test_profiling.py).
+                st = SpanTimer()
+                st.spans_ms = {
+                    "Map stage": res.times.map_ms,
+                    "Process stage": res.times.process_ms,
+                    "Reduce stage": res.times.reduce_ms,
+                }
+                print(st.report(), file=sys.stderr)
             # Opportunistic TPU evidence (no-op on CPU): any CLI run that
             # lands on real hardware leaves a stage-timing row behind.
             from locust_tpu.utils import artifacts
@@ -368,7 +396,7 @@ def _run(args) -> int:
             if res.truncated:
                 print("[locust] WARN: table capacity exceeded; tail keys dropped",
                       file=sys.stderr)
-            with timer.span("output"):
+            with timer.span("output"), obs.span("cli.output"):
                 if args.stage == STAGE_MAP:
                     out = inter[0]
                     res.dump_intermediate(out, args.inter_format)
@@ -382,7 +410,7 @@ def _run(args) -> int:
 
     # STAGE_REDUCE: merge intermediate TSVs from map nodes; always re-sort (Q6).
     with prof:
-        with timer.span("load"):
+        with timer.span("load"), obs.span("cli.load"):
             key_rows_list, values_list = [], []
             for path in inter:
                 k, v = serde.read_intermediate(path, cfg.key_width)
@@ -398,10 +426,10 @@ def _run(args) -> int:
         from locust_tpu.engine import finalize_host_pairs
         from locust_tpu.ops import segment_reduce, sort_and_compact
 
-        with timer.span("run"):
+        with timer.span("run"), obs.span("cli.run"):
             table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), eng.combine)
             pairs = finalize_host_pairs(table, eng.combine)  # device sync
-        with timer.span("output"):
+        with timer.span("output"), obs.span("cli.output"):
             _print_table(pairs, args.limit)
     if args.trace:
         print(timer.report(), file=sys.stderr)
@@ -469,7 +497,7 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
     n_dev = dmr.n_dev
     with prof:
         t0 = _time.perf_counter()
-        with timer.span("load"):
+        with timer.span("load"), obs.span("cli.load"):
             kw = {}
             if args.checkpoint_dir:
                 kw = dict(
@@ -495,7 +523,7 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
                     )
                 )
                 print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
-        with timer.span("run"):
+        with timer.span("run"), obs.span("cli.run"):
             res = (
                 dmr.run_stream(stream, **kw)
                 if args.stream
@@ -546,7 +574,7 @@ def _run_mesh(args, cfg, timer, prof, preloaded_rows=None,
                 "stage": args.stage,
             },
         )
-        with timer.span("output"):
+        with timer.span("output"), obs.span("cli.output"):
             if args.stage == STAGE_MAP:
                 out = inter[0]
                 serde.write_intermediate(pairs, out, args.inter_format)

@@ -369,6 +369,15 @@ class EngineConfig:
     # guarantees is no longer referenced by an in-flight fold.
     stream_staging_ring: bool = True
 
+    # Structured telemetry opt-in (locust_tpu.obs, docs/OBSERVABILITY.md):
+    # True enables the process tracer at engine construction, so API
+    # users get spans/metrics without touching the obs module (the CLI's
+    # --trace-out sets the same switch and adds the export).  Default
+    # False = the zero-overhead no-op path; note the knob is part of the
+    # config repr, so flipping it (like any config change) starts
+    # checkpointed runs fresh.
+    trace: bool = False
+
     def __post_init__(self):
         if self.key_width <= 0 or self.key_width % 4 != 0:
             raise ValueError("key_width must be a positive multiple of 4 (uint32 lanes)")

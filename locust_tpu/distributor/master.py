@@ -60,6 +60,7 @@ import threading
 import time
 import uuid
 
+from locust_tpu import obs
 from locust_tpu.distributor import protocol
 from locust_tpu.io.loader import count_lines
 from locust_tpu.utils import faultplan
@@ -73,6 +74,16 @@ class MasterError(RuntimeError):
 
 class IntegrityError(MasterError):
     """A fetched intermediate failed sha256 verification."""
+
+
+def _scoped_call(tracer, fn, *args, **kw):
+    """Run ``fn(*args, **kw)`` with the obs thread-local pinned to
+    ``tracer`` — the ONE copy of the pool-thread scoping rule: worker
+    threads otherwise fall back to the process tracer, which need not be
+    the one the job was scoped to (or may leak spans a scoped(None)
+    caller masked off)."""
+    with obs.scoped(tracer):
+        return fn(*args, **kw)
 
 
 def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.0) -> dict:
@@ -152,12 +163,15 @@ def _fetch_pipelined(
     faultplan.check_connect(node[0], node[1])
     with socket.create_connection(node, timeout=timeout) as sock:
         sock.settimeout(timeout)
+        stamp = protocol.trace_stamp()  # chunk replies echo it in meta
 
         def send_req(off: int) -> None:
             req = {"cmd": "fetch", "path": remote, "offset": off,
                    "max_bytes": chunk_bytes, "bin": 1}
             if use_zlib:
                 req["accept_zlib"] = True
+            if stamp is not None:
+                req[protocol.TRACE_KEY] = stamp
             protocol.send_frame(sock, req, secret)
 
         send_req(0)
@@ -251,21 +265,31 @@ def fetch_file(
     t0 = time.perf_counter()
     whole = hashlib.sha256()
     rpc_fn = rpc or (lambda nd, rq, s: _rpc(nd, rq, s, timeout=rpc_timeout))
-    with open(local, "wb") as f:
-        if rpc is None and use_binary:
-            _fetch_pipelined(
-                node, remote, expect_sha, stats, f, whole,
-                secret, chunk, window, use_zlib, rpc_fn, rpc_timeout,
-            )
-        else:
-            stats["binary"] = False
-            _fetch_via_rpc(
-                node, remote, expect_sha, stats, f, whole,
-                rpc_fn, secret, chunk,
-            )
+    # One span per transfer = one fetch-pipeline window on the timeline;
+    # byte/throughput metrics aggregate across every fetch of the job.
+    with obs.span(
+        "master.fetch",
+        node=f"{node[0]}:{node[1]}", path=remote,
+        window=window, chunk_bytes=chunk,
+    ):
+        with open(local, "wb") as f:
+            if rpc is None and use_binary:
+                _fetch_pipelined(
+                    node, remote, expect_sha, stats, f, whole,
+                    secret, chunk, window, use_zlib, rpc_fn, rpc_timeout,
+                )
+            else:
+                stats["binary"] = False
+                _fetch_via_rpc(
+                    node, remote, expect_sha, stats, f, whole,
+                    rpc_fn, secret, chunk,
+                )
     stats["elapsed_s"] = round(time.perf_counter() - t0, 6)
     if stats["elapsed_s"] > 0:
         stats["mb_s"] = round(stats["bytes"] / 1e6 / stats["elapsed_s"], 3)
+    obs.metric_inc("fetch.bytes", stats["bytes"])
+    if stats["mb_s"]:
+        obs.metric_observe("fetch.mb_s", stats["mb_s"])
     return stats
 
 
@@ -369,13 +393,29 @@ class ShardStats:
 
 class JobResult(list):
     """The collected local intermediate paths (list API unchanged for
-    callers that only reduce), plus per-shard timing stats and the final
-    health view."""
+    callers that only reduce), plus per-shard timing stats, the final
+    health view, and — when telemetry was enabled — the job's merged
+    cross-node trace."""
 
-    def __init__(self, paths, shards: list[ShardStats], health: WorkerHealth):
+    def __init__(self, paths, shards: list[ShardStats], health: WorkerHealth,
+                 trace=None):
         super().__init__(paths)
         self.shards = shards
         self.health = health
+        self._trace = trace
+
+    def timeline(self) -> dict | None:
+        """The merged cross-node Chrome-trace document: master spans plus
+        every worker's shipped span list, clock-offset-adjusted into the
+        master clock under one trace_id (docs/OBSERVABILITY.md).  None
+        when telemetry was disabled for the job.  Deliberately carries NO
+        metrics snapshot: the job tracer's spans are per-job, but metrics
+        are process-scoped (concurrent jobs share them) — the process
+        snapshot belongs to ``obs.export`` (the master CLI's trace file),
+        not to one job's timeline."""
+        if self._trace is None:
+            return None
+        return self._trace.to_chrome()
 
     def dataplane(self) -> dict:
         """Aggregate data-plane stats over every completed fetch: what
@@ -479,6 +519,31 @@ def run_job(
     # Unique per-job intermediate names: concurrent jobs against the same
     # worker pool must not clobber each other's TSVs.
     job_id = uuid.uuid4().hex[:12]
+    # Cross-node telemetry (docs/OBSERVABILITY.md): when a tracer is
+    # active, every map request carries its trace_id + shard, workers run
+    # under request-scoped child tracers and ship serialized span lists
+    # back in their replies, and _ingest_worker_spans merges them —
+    # shifted by the reply-time clock-offset estimate — into ONE
+    # timeline, surfaced as JobResult.timeline().
+    tracer = obs.current()
+    obs.metric_set("job.workers", n)
+
+    def _ingest_worker_spans(resp, node, t_recv: float) -> None:
+        """Merge a reply's shipped spans (ok AND error replies carry
+        them).  Offset estimate: the worker stamps its wall clock while
+        building the reply, so worker_clock ≈ master t_recv minus the
+        one-way reply latency — good to ~net/2, plenty for timelines."""
+        if tracer is None or not isinstance(resp, dict):
+            return
+        spans = resp.get("spans")
+        if not spans:
+            return
+        clock = resp.get("clock")
+        offset = float(clock) - t_recv if isinstance(clock, (int, float)) else 0.0
+        tracer.ingest(
+            spans, offset_s=offset, process=f"worker {node[0]}:{node[1]}"
+        )
+
     health = health or WorkerHealth(n)
     if inter_format not in ("tsv", "bin"):
         raise ValueError(f"unknown inter_format {inter_format!r}")
@@ -516,7 +581,7 @@ def run_job(
         returns the per-fetch stats dict (JobResult.shards evidence)."""
         try:
             fut = fetch_pool.submit(
-                fetch_file,
+                _scoped_call, tracer, fetch_file,
                 node, remote, local, secret,
                 expect_sha=expect_sha,
                 rpc=None if rpc_is_default else rpc,
@@ -540,20 +605,25 @@ def run_job(
         # clobber the winner's file (loopback runs share one /tmp).
         ext = "kvb" if inter_format == "bin" else "tsv"
         inter = f"/tmp/locust_{job_id}_shard{shard}_a{attempt}.{ext}"
-        resp = rpc(
-            node,
-            {
-                "cmd": "map",
-                "file": input_file,
-                "line_start": start,
-                "line_end": end,
-                "node_num": shard,
-                "intermediate": inter,
-                "inter_format": inter_format,
-                "extra_args": extra_args or [],
-            },
-            secret,
-        )
+        req = {
+            "cmd": "map",
+            "file": input_file,
+            "line_start": start,
+            "line_end": end,
+            "node_num": shard,
+            "intermediate": inter,
+            "inter_format": inter_format,
+            "extra_args": extra_args or [],
+        }
+        # Attempt threads run scoped to the job's tracer (_run_scoped /
+        # attempt()), so the one stamp helper sees the right trace_id.
+        stamp = protocol.trace_stamp(shard)
+        if stamp is not None:
+            req[protocol.TRACE_KEY] = stamp
+        with obs.span("master.map_rpc", shard=shard, worker=node_idx,
+                      attempt=attempt):
+            resp = rpc(node, req, secret)
+        _ingest_worker_spans(resp, node, time.time())
         if resp.get("status") != "ok":
             raise MasterError(
                 f"map failed on node {node}: rc={resp.get('returncode')} "
@@ -620,7 +690,10 @@ def run_job(
 
             def attempt() -> None:
                 try:
-                    done_q.put((aid, node_idx, rec, try_shard(shard, node_idx, aid), None))
+                    local = _scoped_call(
+                        tracer, try_shard, shard, node_idx, aid
+                    )
+                    done_q.put((aid, node_idx, rec, local, None))
                 except (MasterError, OSError, ValueError) as e:
                     done_q.put((aid, node_idx, rec, None, e))
                 except Exception as e:  # noqa: BLE001 - an attempt thread
@@ -716,8 +789,15 @@ def run_job(
     )
     hb.start()
     try:
-        with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
-            results = list(ex.map(one, range(n)))
+        with obs.span("job.run", job=job_id, workers=n, input=input_file):
+            with concurrent.futures.ThreadPoolExecutor(max_workers=n) as ex:
+                # Shard-driver threads likewise pin to the job's tracer.
+                results = list(
+                    ex.map(
+                        lambda shard: _scoped_call(tracer, one, shard),
+                        range(n),
+                    )
+                )
     finally:
         stop.set()
         fetch_pool.shutdown(wait=False)
@@ -729,7 +809,7 @@ def run_job(
             s.shard, s.elapsed_s or -1.0, s.winner, len(s.attempts),
             ", speculated" if s.speculated else "",
         )
-    return JobResult(paths, shards, health)
+    return JobResult(paths, shards, health, trace=tracer)
 
 
 def main(argv=None) -> int:
@@ -757,8 +837,33 @@ def main(argv=None) -> int:
     p.add_argument("--fault-plan", default=None,
                    help="chaos-test fault plan: JSON text or a path "
                         f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="structured telemetry: record master spans, merge "
+                        "every worker's shipped map spans under one "
+                        "trace_id, and export the job as Chrome-trace/"
+                        "Perfetto JSON to FILE (docs/OBSERVABILITY.md)")
     args, passthrough = p.parse_known_args(argv)
     faultplan.install(args.fault_plan)
+    if args.trace_out:
+        obs.enable(process="master")
+    try:
+        return _main(args, passthrough)
+    finally:
+        if args.trace_out:
+            # Export on EVERY path — a failed chaos run's timeline is
+            # the one worth reading; and a broken export must not mask
+            # the run's own outcome (telemetry never takes down a job).
+            try:
+                obs.export(args.trace_out)
+                print(f"[master] trace written to {args.trace_out}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"[master] trace export to {args.trace_out} "
+                      f"failed: {e}", file=sys.stderr)
+            obs.disable()
+
+
+def _main(args, passthrough) -> int:
     secret = os.environ.get(args.secret_env, "").encode()
     if not secret:
         print(f"error: set ${args.secret_env}", file=sys.stderr)
@@ -796,6 +901,8 @@ def main(argv=None) -> int:
     reduce_args = [args.input_file, "-1", "-1", "0", "2"]
     for t in tsvs:
         reduce_args += ["-i", t]
+    # The exported timeline (main()'s finally) then holds master job
+    # spans + every worker's map spans + the in-process reduce's spans.
     return cli.main(reduce_args + passthrough)
 
 

@@ -31,6 +31,7 @@ import threading
 import time
 import zlib
 
+from locust_tpu import obs
 from locust_tpu.distributor import protocol
 from locust_tpu.utils import faultplan
 
@@ -233,39 +234,7 @@ class Worker:
             self._shutdown.set()
             return {"status": "ok", "bye": True}
         if cmd == "map":
-            rule = faultplan.fire(
-                "worker.map", shard=req.get("node_num"), port=self.addr[1]
-            )
-            if rule is not None:
-                if rule.action == "crash":
-                    raise faultplan.FaultCrash("injected crash mid-map")
-                if rule.action == "error":
-                    return {"status": "error", "returncode": -9,
-                            "log": "[faultplan] injected map failure",
-                            "error": "injected map failure"}
-                if rule.action == "delay":
-                    import time as _time
-
-                    _time.sleep(rule.delay_s)
-            try:
-                with self._map_lock:  # one accelerator: maps serialize
-                    resp = self.map_runner(req)
-            except Exception as e:  # propagate failure, don't fake-ACK
-                return {"status": "error", "error": repr(e)}
-            if resp.get("status") == "ok" and "sha256" not in resp:
-                # End-to-end integrity anchor: hash the intermediate at
-                # map time so the master can verify the assembled fetch
-                # against what the map actually wrote (Dean & Ghemawat's
-                # checksummed intermediates).  A runner that wrote no
-                # file (injected test runners) just ships no digest —
-                # the master skips the end-to-end check then, and a
-                # truly missing intermediate still fails at fetch time.
-                inter = resp.get("intermediate") or req.get("intermediate")
-                try:
-                    resp["sha256"] = _file_sha256(inter)
-                except (OSError, TypeError):
-                    pass
-            return resp
+            return self._traced_map(req)
         # fetch: stream back an intermediate file this worker produced, one
         # bounded window per request so arbitrarily large intermediates fit
         # the frame limit (the master pipelines ``offset`` windows until
@@ -306,6 +275,11 @@ class Worker:
             "total": size,
             "eof": eof,
         }
+        tctx = req.get(protocol.TRACE_KEY)
+        if isinstance(tctx, dict) and tctx.get("id"):
+            # Correlation echo in the reply (binary frame) meta: every
+            # fetched chunk is attributable to the job's trace_id.
+            meta["trace_id"] = str(tctx["id"])
         if not (req.get("bin") and self.support_binary):
             # Pre-binary master (or a worker pinned JSON-only): the
             # original base64 JSON reply, byte for byte.
@@ -330,6 +304,73 @@ class Worker:
             else:
                 body = faultplan.active().mutate(rule, body)
         return dict(meta, enc=enc, clen=len(body)), body, flags
+
+    def _traced_map(self, req: dict) -> dict:
+        """Run one map command under a REQUEST-scoped tracer when the
+        master stamped a trace context into the request.
+
+        The tracer is per-request (not the process tracer): a loopback
+        cluster shares one process with its master, and the worker's
+        spans must travel the same path as a remote worker's — serialized
+        in the reply ("spans") with the worker's wall clock ("clock") for
+        the master's offset estimate — never leak directly into a tracer
+        enabled in this process (``obs.scoped`` masks it either way).
+        An error reply ships its spans too (a failed attempt is exactly
+        the part of a chaos timeline worth reading); an injected CRASH
+        drops the connection before any reply, so those spans are lost
+        with the "process" — faithful to what a real SIGKILL leaves.
+        """
+        tctx = req.get(protocol.TRACE_KEY)
+        tracer = None
+        if isinstance(tctx, dict) and tctx.get("id"):
+            tracer = obs.Tracer(
+                trace_id=str(tctx["id"]),
+                process=f"worker:{self.addr[1]}",
+            )
+        with obs.scoped(tracer):
+            with obs.span(
+                "worker.map",
+                shard=req.get("node_num"),
+                port=self.addr[1],
+            ):
+                resp = self._run_map(req)
+        if tracer is not None and isinstance(resp, dict):
+            resp["spans"] = tracer.serialize()
+            resp["clock"] = time.time()
+        return resp
+
+    def _run_map(self, req: dict) -> dict:
+        rule = faultplan.fire(
+            "worker.map", shard=req.get("node_num"), port=self.addr[1]
+        )
+        if rule is not None:
+            if rule.action == "crash":
+                raise faultplan.FaultCrash("injected crash mid-map")
+            if rule.action == "error":
+                return {"status": "error", "returncode": -9,
+                        "log": "[faultplan] injected map failure",
+                        "error": "injected map failure"}
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+        try:
+            with self._map_lock:  # one accelerator: maps serialize
+                resp = self.map_runner(req)
+        except Exception as e:  # propagate failure, don't fake-ACK
+            return {"status": "error", "error": repr(e)}
+        if resp.get("status") == "ok" and "sha256" not in resp:
+            # End-to-end integrity anchor: hash the intermediate at
+            # map time so the master can verify the assembled fetch
+            # against what the map actually wrote (Dean & Ghemawat's
+            # checksummed intermediates).  A runner that wrote no
+            # file (injected test runners) just ships no digest —
+            # the master skips the end-to-end check then, and a
+            # truly missing intermediate still fails at fetch time.
+            inter = resp.get("intermediate") or req.get("intermediate")
+            try:
+                resp["sha256"] = _file_sha256(inter)
+            except (OSError, TypeError):
+                pass
+        return resp
 
     def _read_window(
         self, real: str, offset: int, max_bytes: int, files: dict | None

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from locust_tpu import obs
 from locust_tpu.config import DEFAULT_CONFIG, EngineConfig
 from locust_tpu.core import bytes_ops
 from locust_tpu.core.kv import KVBatch
@@ -183,6 +184,12 @@ class _CheckpointPump:
 
     def mark(self, acc: KVBatch, next_block: int, overflow, max_distinct):
         t0 = time.perf_counter()
+        obs.event(
+            "ckpt.mark",
+            generation=next_block,
+            mode="async" if self._writer is not None else "sync",
+        )
+        obs.metric_inc("ckpt.marks")
         if self._writer is None:
             self._eng._save_state(
                 self._path, acc, next_block, overflow, max_distinct, self._fp
@@ -249,6 +256,11 @@ class MapReduceEngine:
         combine: str = "sum",
     ):
         self.cfg = cfg
+        if cfg.trace:
+            # API-level telemetry opt-in (the CLI's --trace-out does the
+            # same enable + an export at exit); idempotent, shares one
+            # process timeline with any tracer already enabled.
+            obs.enable()
         self.combine = combine  # user-facing semantics (host finalize)
         # "count" lowers to emit-1 + sum so the block-accumulator merge is
         # associative (reduce_stage.normalize_combine); the device pipeline
@@ -415,18 +427,25 @@ class MapReduceEngine:
         max_distinct = jnp.int32(0)
         times = StageTimes()
         for blk in self._blocks(rows):
+            # obs spans shadow the t0..t4 boundaries exactly (each stage's
+            # sync is inside its span), so an exported timeline and the
+            # reference-parity StageTimes report can never disagree.
             t0 = time.perf_counter()
-            kv, blk_overflow = self._map(blk)
-            jax.block_until_ready(kv.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
+            with obs.span("engine.stage.map"):
+                kv, blk_overflow = self._map(blk)
+                jax.block_until_ready(kv.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
             t1 = time.perf_counter()
-            kv = self._process(kv)
-            jax.block_until_ready(kv.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
+            with obs.span("engine.stage.process"):
+                kv = self._process(kv)
+                jax.block_until_ready(kv.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
             t2 = time.perf_counter()
-            table = self._reduce(kv)
-            jax.block_until_ready(table.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
+            with obs.span("engine.stage.reduce"):
+                table = self._reduce(kv)
+                jax.block_until_ready(table.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
             t3 = time.perf_counter()
-            acc, max_distinct = self._merge(acc, table, max_distinct)
-            jax.block_until_ready(acc.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
+            with obs.span("engine.stage.merge"):
+                acc, max_distinct = self._merge(acc, table, max_distinct)
+                jax.block_until_ready(acc.key_lanes)  # locust: noqa[R003] stage-timing boundary (reference parity): the sync IS the measurement
             t4 = time.perf_counter()
             times.map_ms += (t1 - t0) * 1e3
             times.process_ms += (t2 - t1) * 1e3 + (t4 - t3) * 1e3
@@ -525,21 +544,29 @@ class MapReduceEngine:
             for i, blk in enumerate(blocks):
                 if i < start_block:  # resume: re-read, don't re-fold
                     continue
-                blk = (
-                    ring.stage(blk, bl, w)
-                    if ring is not None
-                    else normalize_round_chunk(blk, bl, w)
-                )
-                acc, blk_overflow, distinct = self._fold_block(
-                    acc, jnp.asarray(blk)
-                )
+                # Span covers staging + dispatch, NOT device completion
+                # (folds are async; completion shows up as the later
+                # stream.stall events) — docs/OBSERVABILITY.md.
+                with obs.span("stream.block", i=i,
+                              staging="ring" if ring is not None else "alloc"):
+                    blk = (
+                        ring.stage(blk, bl, w)
+                        if ring is not None
+                        else normalize_round_chunk(blk, bl, w)
+                    )
+                    acc, blk_overflow, distinct = self._fold_block(
+                        acc, jnp.asarray(blk)
+                    )
                 overflow = overflow + blk_overflow
                 max_distinct = jnp.maximum(max_distinct, distinct)
                 inflight.append(blk_overflow)
                 if len(inflight) > self.STREAM_DISPATCH_DEPTH:
                     t_sync = time.perf_counter()
                     jax.block_until_ready(inflight.popleft())  # locust: noqa[R003] bounded-inflight backpressure: sync caps device queue depth, overlap stays STREAM_DISPATCH_DEPTH deep
-                    stall_ms += (time.perf_counter() - t_sync) * 1e3
+                    sync_ms = (time.perf_counter() - t_sync) * 1e3
+                    stall_ms += sync_ms
+                    obs.event("stream.stall", block=i, ms=round(sync_ms, 3))
+                    obs.metric_observe("stream.stall_ms", sync_ms)
                 if pump is not None and (i + 1) % every == 0:
                     pump.mark(acc, i + 1, overflow, max_distinct)
                     last_mark = i + 1
@@ -557,6 +584,7 @@ class MapReduceEngine:
                 pump.close()
         jax.block_until_ready(acc.key_lanes)
         total_ms = (time.perf_counter() - t0) * 1e3
+        obs.metric_inc("stream.blocks", max(0, i + 1 - start_block))
         stream = {
             "blocks": max(0, i + 1 - start_block),
             "staging_ring": ring is not None,

@@ -44,6 +44,7 @@ import os
 import threading
 import time
 
+from locust_tpu import obs
 from locust_tpu.utils import faultplan
 
 logger = logging.getLogger("locust_tpu")
@@ -74,6 +75,9 @@ def finalize_snapshot(tmp: str, path: str, prev_path: str | None = None,
     if prev_path is not None and os.path.exists(path):
         os.replace(path, prev_path)
     os.replace(tmp, path)
+    # Telemetry: the generation is durable from this instant (the
+    # checkpoint-lifecycle event resumes reason about).
+    obs.event("ckpt.publish", generation=generation, path=path)
     # Post-publish bit-rot/truncation chaos (no-op without an active
     # plan) — loaders must validate and fall back.
     faultplan.damage_file("io.checkpoint", path)
@@ -101,6 +105,13 @@ class AsyncCheckpointWriter:
     """
 
     def __init__(self, name: str = "ckpt-writer"):
+        # Telemetry scope captured at CREATION (the fold-loop thread):
+        # the writer daemon's ckpt.write/ckpt.publish must land in the
+        # same tracer as the loop's ckpt.mark — a worker's request-scoped
+        # tracer, not the process tracer of whoever shares the process
+        # (loopback clusters: without this, worker checkpoint writes
+        # would misattribute to the MASTER's timeline).
+        self._obs_tracer = obs.current()
         self._cond = threading.Condition()
         self._pending: tuple[int, object] | None = None
         self._busy = False
@@ -129,6 +140,9 @@ class AsyncCheckpointWriter:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
             if self._pending is not None:
                 self._skipped += 1
+                # Latest-wins lap: the replaced generation never lands.
+                obs.event("ckpt.skip", generation=self._pending[0],
+                          replaced_by=generation)
             self._pending = (generation, write_fn)
             self._submitted += 1
             self._latest_gen = max(self._latest_gen, generation)
@@ -194,7 +208,12 @@ class AsyncCheckpointWriter:
             abandoned = False
             error = None
             try:
-                fn()
+                # Span covers the writer's whole generation: device-ready
+                # wait + device->host copy + npz write + atomic publish —
+                # recorded into the creator's tracer (see __init__).
+                with obs.scoped(self._obs_tracer):
+                    with obs.span("ckpt.write", generation=generation):
+                        fn()
             except faultplan.FaultInjected as e:
                 # An injected writer crash: the snapshot is abandoned and
                 # the previous generation survives on disk — durability

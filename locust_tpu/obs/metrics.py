@@ -1,0 +1,68 @@
+"""Closed-registry metrics: counters, gauges and histograms.
+
+The registry is ``locust_tpu.obs.names`` (one dict for spans, events AND
+metrics); an unregistered or kind-mismatched name raises on the enabled
+path, and analysis rule R009 pins the same contract statically.  The
+histogram keeps streaming moments (count/sum/min/max) — enough for the
+bench's ``obs`` sub-dict without bucket configuration.
+
+Thread-safe under one lock (stream folds, the async checkpoint writer
+and distributor fetch threads all emit concurrently); the zero-overhead
+disabled path lives in ``locust_tpu.obs.__init__``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from locust_tpu.obs import names as _names
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict] = {}
+
+    def inc(self, name: str, n: float = 1) -> None:
+        _names.check(name, "counter")
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set(self, name: str, value: float) -> None:
+        _names.check(name, "gauge")
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        _names.check(name, "histogram")
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "count": 0, "sum": 0.0, "min": value, "max": value,
+                }
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+
+    def snapshot(self) -> dict:
+        """One JSON-able view (bench ``obs`` sub-dict, trace otherData)."""
+        with self._lock:
+            hists = {
+                k: dict(
+                    h,
+                    sum=round(h["sum"], 3),
+                    min=round(h["min"], 3),
+                    max=round(h["max"], 3),
+                    mean=round(h["sum"] / h["count"], 3) if h["count"] else 0.0,
+                )
+                for k, h in self._hists.items()
+            }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": hists,
+            }

@@ -1,0 +1,68 @@
+"""The closed telemetry name registry — every span, instant event and
+metric the framework can emit, in ONE dict literal.
+
+Closed-registry stance (same as ``faultplan.SITES`` and the analysis rule
+table): a typo'd name at an emission site must fail LOUDLY — at runtime
+(``Tracer``/``Metrics`` validate against this dict when telemetry is
+enabled) and statically (analysis rule R009 checks both directions: every
+``obs.span``/``obs.event``/``obs.metric_*`` literal exists here, and
+every entry here is emitted somewhere under ``locust_tpu/``).  A name
+nobody validates is a timeline nobody can correlate.
+
+Emission convention (what R009 can see): emit through the ``obs`` module
+functions with a literal name — ``obs.span("engine.stage.map")``, never a
+name built at runtime.  Kinds: ``span`` (duration), ``event`` (instant),
+``counter``/``gauge``/``histogram`` (metrics).
+"""
+
+from __future__ import annotations
+
+NAMES = {
+    # --- spans (durations) -------------------------------------------
+    "job.run": "span",              # master: one distributor job end-to-end
+    "master.map_rpc": "span",       # master: one shard map attempt RPC
+    "master.fetch": "span",         # master: one intermediate transfer
+    "worker.map": "span",           # worker: one map command (runner incl.)
+    "cli.load": "span",             # CLI: corpus ingest
+    "cli.run": "span",              # CLI: the engine run
+    "cli.output": "span",           # CLI: table print / intermediate write
+    "engine.stage.map": "span",     # timed_run Map stage (per block)
+    "engine.stage.process": "span", # timed_run Process stage (per block)
+    "engine.stage.reduce": "span",  # timed_run Reduce stage (per block)
+    "engine.stage.merge": "span",   # timed_run cross-block table merge
+    "stream.block": "span",         # run_stream: stage+dispatch of one block
+    "ckpt.write": "span",           # async writer: serialize+publish one gen
+    # --- instant events ----------------------------------------------
+    "fault.injected": "event",      # a faultplan rule fired (site, action)
+    "ckpt.mark": "event",           # fold loop marked a snapshot generation
+    "ckpt.publish": "event",        # finalize_snapshot atomic rename landed
+    "ckpt.skip": "event",           # latest-wins replaced a pending mark
+    "stream.stall": "event",        # bounded-inflight backpressure sync
+    "obs.device_join": "event",     # xplane family times joined onto a stage
+    # --- metrics ------------------------------------------------------
+    "job.workers": "gauge",         # cluster size of the running job
+    "stream.blocks": "counter",     # blocks folded by run_stream
+    "stream.stall_ms": "histogram", # per-sync backpressure stall
+    "ckpt.marks": "counter",        # snapshot generations marked
+    "fault.injections": "counter",  # faults injected across all sites
+    "fetch.bytes": "counter",       # intermediate payload bytes fetched
+    "fetch.mb_s": "histogram",      # per-fetch payload throughput
+}
+
+METRIC_KINDS = ("counter", "gauge", "histogram")
+
+
+def check(name: str, kind: str) -> None:
+    """Loud closed-registry validation (enabled-path only)."""
+    got = NAMES.get(name)
+    if got is None:
+        raise ValueError(
+            f"telemetry name {name!r} is not in the obs NAMES registry "
+            "(locust_tpu/obs/names.py) — register it; a typo'd name "
+            "records nothing the timeline can correlate"
+        )
+    if got != kind:
+        raise ValueError(
+            f"telemetry name {name!r} is registered as a {got}, "
+            f"emitted as a {kind} — kind mismatch"
+        )

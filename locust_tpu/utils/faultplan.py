@@ -274,19 +274,36 @@ def install(spec: str | None = None, env_var: str = ENV_VAR) -> FaultPlan | None
 # overhead contract.  Call sites stay one line.
 
 
+def _note(site: str, rule: FaultRule) -> None:
+    """Telemetry: an injected fault becomes an instant event on the
+    active trace (+ a counter), so chaos runs debug as timelines
+    (docs/OBSERVABILITY.md).  Reached only when a rule FIRED — a run
+    with no plan (or no matching rule) never pays this call."""
+    from locust_tpu import obs
+
+    obs.event("fault.injected", site=site, action=rule.action,
+              rule=rule.index, fired=rule.fired)
+    obs.metric_inc("fault.injections")
+
+
 def fire(site: str, **ctx) -> FaultRule | None:
     """Generic hook: the matched-and-armed rule, or None.  Sites with
     bespoke behavior (worker.map) branch on the returned rule.action."""
     if _PLAN is None:
         return None
-    return _PLAN.fire(site, ctx)
+    rule = _PLAN.fire(site, ctx)
+    if rule is not None:
+        _note(site, rule)
+    return rule
 
 
 def check_connect(host: str, port: int) -> None:
     """rpc.connect: raise ConnectionRefusedError as if nothing listened."""
     if _PLAN is None:
         return
-    if _PLAN.fire("rpc.connect", {"host": host, "port": port}) is not None:
+    rule = _PLAN.fire("rpc.connect", {"host": host, "port": port})
+    if rule is not None:
+        _note("rpc.connect", rule)
         raise ConnectionRefusedError(
             f"[faultplan] injected connect refusal to {host}:{port}"
         )
@@ -299,6 +316,7 @@ def mangle(site: str, data: bytes, keep_prefix: int = 0, **ctx) -> bytes:
     rule = _PLAN.fire(site, ctx)
     if rule is None:
         return data
+    _note(site, rule)
     return _PLAN.mutate(rule, data, keep_prefix=keep_prefix)
 
 
@@ -310,6 +328,7 @@ def delay(site: str, **ctx) -> None:
         return
     rule = _PLAN.fire(site, ctx)
     if rule is not None and rule.delay_s > 0:
+        _note(site, rule)
         time.sleep(rule.delay_s)
 
 
@@ -320,6 +339,7 @@ def damage_file(site: str, path: str, **ctx) -> None:
     rule = _PLAN.fire(site, dict(ctx, path=path))
     if rule is None:
         return
+    _note(site, rule)
     try:
         with open(path, "rb") as f:
             data = f.read()

@@ -63,9 +63,17 @@ class SpanTimer:
             ) * 1e3
 
     def report(self) -> str:
-        width = max((len(k) for k in self.spans_ms), default=0)
+        """Spans sorted by descending time with a percent-of-total column
+        (stable: ties break on name, so repeated reports are diffable)."""
+        if not self.spans_ms:
+            return ""
+        total = sum(self.spans_ms.values())
+        width = max(len(k) for k in self.spans_ms)
+        rows = sorted(self.spans_ms.items(), key=lambda kv: (-kv[1], kv[0]))
         return "\n".join(
-            f"{k.ljust(width)}  {v:10.3f} ms" for k, v in self.spans_ms.items()
+            f"{k.ljust(width)}  {v:10.3f} ms  "
+            f"{(100.0 * v / total if total else 0.0):5.1f}%"
+            for k, v in rows
         )
 
 
@@ -163,10 +171,23 @@ def parse_xplane(path: str, top_n: int = 12) -> dict:
     return out
 
 
-def newest_xplane(out_dir: str) -> str | None:
-    paths = glob.glob(
+def _xplane_paths(out_dir: str) -> list[str]:
+    return glob.glob(
         os.path.join(out_dir, "**", "*.xplane.pb"), recursive=True
     )
+
+
+def newest_xplane(out_dir: str, exclude=()) -> str | None:
+    """Newest capture under ``out_dir``, skipping ``exclude`` paths.
+
+    ``exclude`` exists for the stale-capture bug: callers that reuse an
+    ``out_dir`` must snapshot the pre-existing ``*.xplane.pb`` paths
+    before tracing and pass them here, or an EARLIER run's capture (mtime
+    ordering is not creation ordering across filesystems/clock steps)
+    can be returned as "the" capture of a trace that produced nothing.
+    """
+    exclude = set(exclude)
+    paths = [p for p in _xplane_paths(out_dir) if p not in exclude]
     return max(paths, key=os.path.getmtime) if paths else None
 
 
@@ -175,9 +196,14 @@ def profile_device(fn, out_dir: str) -> tuple[object, dict, str | None]:
 
     Returns ``(fn_result, summary, xplane_path)``; a capture or parse
     failure returns ``summary={"error": ...}`` (result ``None`` if the
-    trace context itself raised).
+    trace context itself raised).  Only a capture the trace itself
+    produced is ever returned: pre-existing ``*.xplane.pb`` files in a
+    reused ``out_dir`` are snapshotted before tracing and excluded, so a
+    failed capture reports the failure instead of silently handing back
+    last run's profile as this run's evidence.
     """
     os.makedirs(out_dir, exist_ok=True)
+    pre_existing = set(_xplane_paths(out_dir))
     try:
         with jax.profiler.trace(out_dir):
             result = fn()
@@ -185,8 +211,14 @@ def profile_device(fn, out_dir: str) -> tuple[object, dict, str | None]:
     except Exception as e:  # noqa: BLE001 - the run may have succeeded
         # outside the profiler's control; report the capture failure.
         return None, {"error": f"trace failed: {type(e).__name__}: {e}"}, None
-    path = newest_xplane(out_dir)
+    path = newest_xplane(out_dir, exclude=pre_existing)
     if path is None:
-        return result, {"error": "no xplane.pb produced"}, None
+        msg = "no xplane.pb produced"
+        if pre_existing:
+            msg += (
+                f" (ignored {len(pre_existing)} stale capture(s) already "
+                "in the output dir)"
+            )
+        return result, {"error": msg}, None
     return result, parse_xplane(path), path
 

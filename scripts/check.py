@@ -1,12 +1,15 @@
 #!/usr/bin/env python
-"""One-shot dev gate: static analysis + its test suite.
+"""One-shot dev gate: static analysis + its test suite + a traced run.
 
     env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
 
 Runs (1) the invariant checker over the configured paths (exit 1 on new
-findings — docs/ANALYSIS.md) and (2) tests/test_analysis.py, which
-includes the repo-wide gate test.  ``--fast`` skips the pytest half.
-Exit code is non-zero if either half fails.
+findings — docs/ANALYSIS.md), (2) tests/test_analysis.py, which includes
+the repo-wide gate test, and (3) a small traced engine run whose
+exported timeline is validated against locust_tpu/obs/trace.schema.json (the obs
+contract, docs/OBSERVABILITY.md) — in a subprocess with a pinned env, so
+this process stays jax-free.  ``--fast`` skips (2) and (3).
+Exit code is non-zero if any part fails.
 """
 
 from __future__ import annotations
@@ -46,11 +49,49 @@ def main(argv=None) -> int:
         [sys.executable, "-m", "pytest", "tests/test_analysis.py", "-q"],
         cwd=REPO, env=env, timeout=600,
     )
+
+    # Traced round-trip: a tiny engine run under the obs tracer, exported
+    # and schema-validated — the telemetry contract every --trace-out run
+    # rides.  Subprocess (same pinned env) keeps THIS process jax-free.
+    trace_rc = subprocess.run(
+        [sys.executable, "-c", _TRACE_ROUNDTRIP], cwd=REPO, env=env,
+        timeout=300,
+    ).returncode
     print(
-        f"[check] tests: rc={proc.returncode}; analysis rc={rc}",
+        f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
+        f"trace round-trip rc={trace_rc}",
         file=sys.stderr,
     )
-    return rc or proc.returncode
+    return rc or proc.returncode or trace_rc
+
+
+_TRACE_ROUNDTRIP = """
+import sys, tempfile, os
+from locust_tpu.backend import force_cpu
+force_cpu()
+from locust_tpu import obs
+from locust_tpu.config import EngineConfig
+from locust_tpu.engine import MapReduceEngine
+from locust_tpu.obs.schema import validate_trace
+obs.enable(process="check")
+eng = MapReduceEngine(
+    EngineConfig(block_lines=8, line_width=32, key_width=8, emits_per_line=4)
+)
+eng.timed_run(eng.rows_from_lines([b"a b a", b"b c", b"c a b"]))
+with tempfile.TemporaryDirectory() as td:
+    path = os.path.join(td, "check.trace.json")
+    doc = obs.export(path)
+    validate_trace(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+need = {"engine.stage.map", "engine.stage.process", "engine.stage.reduce"}
+missing = need - names
+if missing:
+    print(f"[check] trace round-trip missing spans: {missing}",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[check] trace round-trip ok ({len(names)} span/event names)",
+      file=sys.stderr)
+"""
 
 
 if __name__ == "__main__":

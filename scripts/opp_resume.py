@@ -91,13 +91,20 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
     Records a ``profiled_roofline`` row — measured sort-family device
     ms, the model's estimated sort bytes, the measured utilization they
     imply, the device plane's top ops, and the xplane path (farm_loop
-    commits ``artifacts/profiles`` alongside the ledger).
+    commits ``artifacts/profiles`` alongside the ledger) — AND, through
+    the obs attribution path (locust_tpu.obs.attribution, the family
+    pairing's one home), a ``stage_device_time`` row with the xplane
+    sort/scatter/dot families joined onto the Process stage.  Both rows
+    are recorded with ``force=True``: CPU-fallback runs leave
+    ``backend: "cpu"`` rows (every TPU-evidence reader filters on
+    backend), TPU windows land the real thing — no extra sweep phases.
     """
     import bench
     import jax
 
     from locust_tpu.engine import MapReduceEngine
-    from locust_tpu.utils import artifacts, profiling, roofline
+    from locust_tpu.obs import attribution
+    from locust_tpu.utils import artifacts, roofline
 
     row = {"sort_mode": sort_mode, "block_lines": block_lines, "caps": caps,
            "table_size": table_size,
@@ -121,8 +128,8 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
             f"{int(time.time())}_{backend}_{sort_mode}_{block_lines}",
         )
         t0 = time.perf_counter()
-        res, summary, xplane = profiling.profile_device(
-            lambda: eng.run_blocks(blocks), prof_dir
+        res, summary, xplane, join = attribution.attributed_run(
+            lambda: eng.run_blocks(blocks), prof_dir, sort_mode
         )
         row["wall_s"] = round(time.perf_counter() - t0, 3)
         row["device_plane"] = summary.get("device_plane")
@@ -166,23 +173,14 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
         )
         row["est_sort_traffic_bytes"] = model["est_sort_traffic_bytes"]
         peak = roofline.PEAK_HBM_GB_S.get(jax.devices()[0].device_kind)
-        # The sort-free hasht family's Process work is scatters + probe
-        # gathers, never "sort.*" HLOs — pair its traffic model with the
-        # scatter family; sort modes pair with the sort family.  For
-        # hasht-mxu the model ADDS the one-hot bytes (roofline
-        # est_onehot_bytes), so the time side must add the dot family the
-        # contraction lowers to — pairing one-hot-dominated bytes with a
-        # dot-free time would inflate utilization past honesty (review
-        # finding, r6).
-        from locust_tpu.config import HASHT_FAMILY
-
-        sort_ms = row.get("sort_device_ms")
-        if sort_mode in HASHT_FAMILY:
-            sort_ms = (row.get("scatter_device_ms") or 0) + (sort_ms or 0)
-            row["process_family"] = "scatter+sort"
-            if sort_mode == "hasht-mxu":
-                sort_ms += row.get("dot_device_ms") or 0
-                row["process_family"] = "scatter+sort+dot"
+        # Family pairing (sort modes = sort HLOs; hasht adds scatters;
+        # hasht-mxu adds the one-hot dots so one-hot bytes never pair
+        # with a dot-free time — review finding, r6) now lives in ONE
+        # place: locust_tpu.obs.attribution.family_join.
+        sort_ms = None
+        if "error" not in join:
+            row["process_family"] = join["process_family"]
+            sort_ms = join["process_device_ms"]
         if sort_ms and peak:
             # The model is an upper bound on traffic; this quotient is
             # therefore an upper bound on utilization FROM MEASURED TIME
@@ -191,9 +189,19 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
             ach = model["est_sort_traffic_bytes"] / 1e9 / (sort_ms / 1e3)
             row["measured_sort_gb_s"] = round(ach, 2)
             row["measured_hbm_utilization_pct"] = round(100 * ach / peak, 2)
+        # The attribution evidence row (VERDICT r5 next #3 plumbing):
+        # xplane families joined onto the Process stage, same capture.
+        attribution.record_stage_device_row(
+            join,
+            {"sort_mode": sort_mode, "block_lines": block_lines,
+             "table_size": table_size, "caps": caps,
+             "corpus_mb": row["corpus_mb"],
+             "capture_backend": row.get("capture_backend")},
+            force=True,
+        )
     except Exception as e:  # noqa: BLE001 - evidence, never kills the sweep
         row["error"] = f"{type(e).__name__}: {e}"[:300]
-    artifacts.record("profiled_roofline", row)
+    artifacts.record("profiled_roofline", row, force=True)
     print(f"[opp] profiled roofline: {row}", file=sys.stderr)
 
 

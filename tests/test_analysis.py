@@ -507,6 +507,125 @@ def test_r008_repo_has_no_tracked_artifacts_and_gitignore_covers():
     assert not res.new, [f.format() for f in res.new]
 
 
+# ------------------------------------------------------------------- R009
+
+_FIXTURE_OBS_NAMES = """
+    NAMES = {
+        "a.span": "span",
+        "b.blocks": "counter",
+        "c.fired": "event",
+    }
+"""
+
+
+def _r009_tree(tmp_path, emitter=None, names=_FIXTURE_OBS_NAMES):
+    _write(tmp_path, "locust_tpu/obs/names.py", names)
+    _write(tmp_path, "locust_tpu/eng.py", emitter if emitter is not None else """
+        from locust_tpu import obs
+
+        def run():
+            with obs.span("a.span", i=0):
+                obs.metric_inc("b.blocks")
+                obs.event("c.fired", site="x")
+    """)
+
+
+def test_r009_silent_when_registry_and_emitters_agree(tmp_path):
+    _r009_tree(tmp_path)
+    assert not _run(tmp_path, ["R009"], ["locust_tpu"]).new
+
+
+def test_r009_fires_on_typod_emission_name(tmp_path):
+    _r009_tree(tmp_path, emitter="""
+        from locust_tpu import obs
+
+        def run():
+            with obs.span("a.spam"):   # typo'd
+                obs.metric_inc("b.blocks")
+                obs.event("c.fired")
+    """)
+    res = _run(tmp_path, ["R009"], ["locust_tpu"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "a.spam" in msgs and "not in the obs NAMES registry" in msgs
+    # ...and the registered-but-now-unemitted 'a.span' fires the other side.
+    assert "never emitted" in msgs and "'a.span'" in msgs
+
+
+def test_r009_fires_on_kind_mismatch_and_unemitted_entry(tmp_path):
+    _r009_tree(tmp_path, emitter="""
+        from locust_tpu import obs
+
+        def run():
+            with obs.span("a.span"):
+                obs.metric_observe("b.blocks", 1.0)  # counter as histogram
+    """)
+    res = _run(tmp_path, ["R009"], ["locust_tpu"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "kind drift" in msgs and "b.blocks" in msgs
+    assert "never emitted" in msgs and "'c.fired'" in msgs
+
+
+def test_r009_ignores_non_obs_span_lookalikes(tmp_path):
+    # SpanTimer.span("load") and other objects' .event(...) must never
+    # be claimed by the rule — only the obs module-function convention.
+    _r009_tree(tmp_path, emitter="""
+        from locust_tpu import obs
+        from locust_tpu.utils import SpanTimer
+
+        def run(timer: SpanTimer, sock):
+            with timer.span("load"):
+                pass
+            sock.event("connected")
+            with obs.span("a.span"):
+                obs.metric_inc("b.blocks")
+                obs.event("c.fired")
+    """)
+    assert not _run(tmp_path, ["R009"], ["locust_tpu"]).new
+
+
+def test_r009_missing_registry_is_one_loud_finding(tmp_path):
+    _write(tmp_path, "locust_tpu/eng.py", """
+        from locust_tpu import obs
+
+        def run():
+            obs.event("c.fired")
+    """)
+    res = _run(tmp_path, ["R009"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "cannot parse the NAMES registry" in res.new[0].message
+
+
+def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
+    """R004-style acceptance demo on the REAL tree: copy obs/names.py and
+    the real emitters, register one phantom name — the gate must fail
+    with exactly the never-emitted finding for it."""
+    for rel in (
+        "locust_tpu/obs/names.py",
+        "locust_tpu/engine.py",
+        "locust_tpu/io/snapshot.py",
+        "locust_tpu/utils/faultplan.py",
+        "locust_tpu/distributor/master.py",
+        "locust_tpu/distributor/worker.py",
+        "locust_tpu/cli.py",
+        "locust_tpu/obs/attribution.py",
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    assert not _run(tmp_path, ["R009"], ["locust_tpu"]).new  # faithful: green
+
+    np_ = tmp_path / "locust_tpu/obs/names.py"
+    mutated = np_.read_text().replace(
+        "NAMES = {", 'NAMES = {\n    "obs.phantom": "event",', 1
+    )
+    assert "obs.phantom" in mutated
+    np_.write_text(mutated)
+    res = _run(tmp_path, ["R009"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "obs.phantom" in res.new[0].message
+    assert "never emitted" in res.new[0].message
+
+
 # --------------------------------------------------------- noqa + baseline
 
 
@@ -654,6 +773,7 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
+        "R009",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])
