@@ -400,6 +400,25 @@ class EngineConfig:
         """Number of uint32 big-endian lanes a packed key occupies."""
         return self.key_width // 4
 
+    def fingerprint(self) -> str:
+        """Stable digest of EVERY config field — the executable-identity
+        half of the serve tier's warm-cache key (docs/SERVING.md): two
+        configs share a compiled program iff their fingerprints match.
+        Built on ``repr`` of the frozen dataclass (field order is the
+        class definition, values are literals), the same identity the
+        checkpoint fingerprints already ride (``run_stream`` embeds
+        ``repr(cfg)``), so "same executable" and "same checkpoint
+        lineage" can never disagree about what a config IS.  Memoized:
+        the serve scheduler keys every pending job by it on every poll
+        tick, and a frozen config's identity never changes."""
+        fp = self.__dict__.get("_fingerprint")
+        if fp is None:
+            import hashlib
+
+            fp = hashlib.sha1(repr(self).encode()).hexdigest()[:12]
+            object.__setattr__(self, "_fingerprint", fp)
+        return fp
+
     @property
     def emits_per_block(self) -> int:
         """Emit-table rows per block (analog of MAX_EMITS, main.cu:20)."""

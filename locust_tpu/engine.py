@@ -330,6 +330,20 @@ class MapReduceEngine:
                 KVBatch.empty(tsize, cfg.key_lanes), blocks
             )
         )
+        # Batched job executable (the serve tier's coalesced dispatch,
+        # docs/SERVING.md): vmap the whole-corpus scan over a leading JOB
+        # axis, so N compatible small jobs fold in ONE device dispatch
+        # with per-job tables/counters out.  Each job slot gets its own
+        # fresh accumulator (no donation: slots are independent and the
+        # batch is rebuilt per dispatch); traced/compiled lazily on first
+        # use per [njobs, nblocks] shape — non-serve users never pay it.
+        self._scan_blocks_batch = jax.jit(
+            jax.vmap(
+                lambda blocks: scan_blocks_into(
+                    KVBatch.empty(tsize, cfg.key_lanes), blocks
+                )
+            )
+        )
 
         # Split stages for the timed path only.
         def merge_tables(acc: KVBatch, table: KVBatch, max_distinct: jax.Array):
@@ -404,6 +418,36 @@ class MapReduceEngine:
         num = int(num)  # host sync: the scan (and everything before) is done
         total_ms = (time.perf_counter() - t0) * 1e3
         return self._finish(acc, num, int(overflow), StageTimes(0, total_ms, 0))
+
+    def run_batch(self, blocks: jax.Array) -> list[RunResult]:
+        """One dispatch over a JOB-batched ``[njobs, nblocks, block_lines,
+        width]`` stack: every job folds independently (vmapped scan) and
+        the per-job tables/counters demultiplex back into one RunResult
+        per job.  The serve tier's coalesced executable (docs/SERVING.md):
+        compatible queued small jobs share this single compiled program
+        instead of paying one dispatch (and one compile shape) each.
+        Zero-filled job slots (batch padding) fold to empty tables.
+        ``StageTimes`` carries the WHOLE batch's wall per job — per-job
+        wall latency is the caller's (the daemon times submit->done).
+        """
+        t0 = time.perf_counter()
+        acc, overflow, num = self._scan_blocks_batch(blocks)
+        num = np.asarray(num)  # host sync: the batch is done
+        overflow = np.asarray(overflow)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        return [
+            self._finish(
+                KVBatch(
+                    key_lanes=acc.key_lanes[j],
+                    values=acc.values[j],
+                    valid=acc.valid[j],
+                ),
+                int(num[j]),
+                int(overflow[j]),
+                StageTimes(0, total_ms, 0),
+            )
+            for j in range(blocks.shape[0])
+        ]
 
     def run_fused(self, rows: np.ndarray) -> RunResult:
         """Whole-corpus run as a single device dispatch (lax.scan over blocks).

@@ -32,6 +32,10 @@ NAMES = {
     "engine.stage.merge": "span",   # timed_run cross-block table merge
     "stream.block": "span",         # run_stream: stage+dispatch of one block
     "ckpt.write": "span",           # async writer: serialize+publish one gen
+    "serve.queue_wait": "span",     # serve: dispatcher waiting on the queue
+    "serve.compile_or_hit": "span", # serve: warm-executable cache lookup/build
+    "serve.dispatch": "span",       # serve: one coalesced batch dispatch
+    "serve.demux": "span",          # serve: per-job result split + store
     # --- instant events ----------------------------------------------
     "fault.injected": "event",      # a faultplan rule fired (site, action)
     "ckpt.mark": "event",           # fold loop marked a snapshot generation
@@ -39,6 +43,8 @@ NAMES = {
     "ckpt.skip": "event",           # latest-wins replaced a pending mark
     "stream.stall": "event",        # bounded-inflight backpressure sync
     "obs.device_join": "event",     # xplane family times joined onto a stage
+    "serve.admit": "event",         # serve: job admitted to the queue
+    "serve.reject": "event",        # serve: admission rejected (reason code)
     # --- metrics ------------------------------------------------------
     "job.workers": "gauge",         # cluster size of the running job
     "stream.blocks": "counter",     # blocks folded by run_stream
@@ -47,6 +53,11 @@ NAMES = {
     "fault.injections": "counter",  # faults injected across all sites
     "fetch.bytes": "counter",       # intermediate payload bytes fetched
     "fetch.mb_s": "histogram",      # per-fetch payload throughput
+    "serve.jobs": "counter",        # serve: jobs completed by the daemon
+    "serve.latency_ms": "histogram",  # serve: per-job submit->done latency
+    "serve.exec_cache_hits": "counter",    # warm-executable cache hits
+    "serve.exec_cache_misses": "counter",  # ... and compiles/builds paid
+    "serve.result_cache_hits": "counter",  # result cache answered a submit
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")

@@ -18,9 +18,12 @@ flat axis so the all-to-all crosses ICI within a slice and DCN across.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import numpy as np
 
+logger = logging.getLogger("locust_tpu")
 
 DATA_AXIS = "data"
 SLICE_AXIS = "slice"
@@ -99,6 +102,37 @@ def initialize_multihost(
     The launcher (locust_tpu/distributor/) passes these per-worker; inside
     managed TPU environments all three are auto-detected and may be None.
     """
+    # Multi-process CPU pods (the virtual-pod test rig; real pods are
+    # TPU) need a cross-process collectives backend: jax >= 0.4.36
+    # defaults the CPU client to collectives "none", which makes ANY
+    # multiprocess CPU computation raise "Multiprocess computations
+    # aren't implemented on the CPU backend".  Flip to the bundled gloo
+    # impl while the backend client does not exist yet (this must run
+    # BEFORE first device use; jax.distributed.initialize below is
+    # exactly that point).  Only for explicitly-CPU runs — TPU pods
+    # keep their native collectives untouched.
+    # The flag holder is a jax-private symbol (not a jax.config attribute
+    # in jax 0.4.36/37), so reach for it defensively: if a future jax
+    # moves it, skip the flip with a warning — the run then degrades to
+    # jax's own collectives default instead of crashing at init.
+    try:
+        from jax._src import xla_bridge as _xla_bridge
+
+        _cpu_coll = getattr(
+            _xla_bridge, "CPU_COLLECTIVES_IMPLEMENTATION", None
+        )
+        if _cpu_coll is None:
+            raise AttributeError(
+                "jax._src.xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION missing"
+            )
+        plats = (jax.config.jax_platforms or "").split(",")
+        if "cpu" in plats and _cpu_coll.value == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:  # noqa: BLE001 - best-effort compat shim
+        logger.warning(
+            "cpu collectives default not flipped (%s); multiprocess CPU "
+            "runs may fail with 'not implemented'", e,
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

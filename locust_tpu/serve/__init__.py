@@ -1,0 +1,38 @@
+"""locust_tpu.serve — the persistent multi-tenant job service.
+
+From one-shot CLI to a serving layer (ROADMAP item 1, docs/SERVING.md):
+a resident engine daemon serving concurrent jobs over the distributor's
+authenticated frame protocol, with admission control + per-tenant
+weighted fairness (scheduler), a warm-executable cache + shape-bucketed
+batching (cache/batch + engine.run_batch), and a restart-persistent
+result cache riding the async snapshot writer (cache.WarmState).
+
+    python -m locust_tpu.serve                     # run the daemon
+    python -m locust_tpu.serve submit FILE ...     # submit + wait
+    python -m locust_tpu.serve stats|shutdown      # operate it
+
+jax-free at import (the daemon pulls the engine in lazily at first
+dispatch), so clients and supervisors import this before — or without —
+backend selection.
+"""
+
+from locust_tpu.serve.cache import (  # noqa: F401
+    ExecutableCache,
+    ResultCache,
+    WarmState,
+    bucket_blocks,
+)
+from locust_tpu.serve.client import ServeClient, ServeError  # noqa: F401
+from locust_tpu.serve.daemon import (  # noqa: F401
+    SERVE_COMMANDS,
+    ServeConfig,
+    ServeDaemon,
+)
+from locust_tpu.serve.jobs import (  # noqa: F401
+    ERROR_CODES,
+    JOB_STATES,
+    WORKLOADS,
+    Job,
+    JobSpec,
+)
+from locust_tpu.serve.scheduler import AdmitReject, FairScheduler  # noqa: F401

@@ -1,0 +1,220 @@
+"""CLI surface for the serve tier.
+
+    python -m locust_tpu.serve [--host H] [--port P] [--secret-env VAR]
+        [--max-queue N] [--max-batch N] [--warm-dir DIR]
+        [--fault-plan PLAN] [--trace-out FILE]        # run the daemon
+
+    python -m locust_tpu.serve submit FILE [--tenant T] [--weight W]
+        [--block-lines N] [--sort-mode M] [--no-wait] ...   # one job
+    python -m locust_tpu.serve result JOB_ID [--wait]       # fetch by id
+    python -m locust_tpu.serve stats                        # daemon stats
+    python -m locust_tpu.serve shutdown                     # stop it
+
+A structured daemon rejection (``ServeError``) prints as
+``error: [code] message`` and exits 1 — the code is the machine-readable
+part (``queue_full`` -> back off, ``not_done`` -> poll again, ...).
+
+The daemon refuses to start without a shared secret (same Q8 stance as
+the distributor worker); clients read the same env var.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from locust_tpu.utils import faultplan
+
+_CLIENT_CMDS = ("submit", "result", "stats", "shutdown")
+
+
+def _secret(args) -> bytes:
+    secret = os.environ.get(args.secret_env, "").encode()
+    if not secret:
+        print(f"error: set ${args.secret_env} (refusing unauthenticated "
+              "mode)", file=sys.stderr)
+        raise SystemExit(2)
+    return secret
+
+
+def _daemon_main(argv) -> int:
+    p = argparse.ArgumentParser(prog="locust-serve")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1347)
+    p.add_argument("--secret-env", default="LOCUST_SECRET")
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--tenant-quota", type=int, default=32,
+                   help="pending jobs per tenant (0 = unlimited)")
+    p.add_argument("--warm-dir", default=None,
+                   help="persist the result cache across restarts here "
+                        "(async snapshot writer, docs/SERVING.md)")
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos-test fault plan: JSON text or a path "
+                        f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="export the daemon's serve.* telemetry as "
+                        "Chrome-trace JSON at exit (docs/OBSERVABILITY.md)")
+    args = p.parse_args(argv)
+    faultplan.install(args.fault_plan)
+    from locust_tpu import obs
+
+    if args.trace_out:
+        obs.enable(process="serve")
+    from locust_tpu.serve.daemon import ServeConfig, ServeDaemon
+
+    daemon = ServeDaemon(
+        args.host, args.port, _secret(args),
+        cfg=ServeConfig(
+            max_queue=args.max_queue,
+            max_batch=args.max_batch,
+            tenant_quota=args.tenant_quota,
+            warm_dir=args.warm_dir,
+        ),
+    )
+    print(f"[serve] listening on {daemon.addr[0]}:{daemon.addr[1]}",
+          file=sys.stderr)
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        # serve_forever's finally already flushed warm state + closed.
+        print("[serve] interrupted; warm state flushed", file=sys.stderr)
+    finally:
+        if args.trace_out:
+            try:
+                obs.export(args.trace_out)
+                print(f"[serve] trace written to {args.trace_out}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"[serve] trace export failed: {e}", file=sys.stderr)
+            obs.disable()
+    return 0
+
+
+def _client(args):
+    from locust_tpu.serve.client import ServeClient
+
+    return ServeClient((args.host, args.port), _secret(args))
+
+
+def _submit_main(argv) -> int:
+    p = argparse.ArgumentParser(prog="locust-serve submit")
+    p.add_argument("file", help="corpus file (sent inline)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1347)
+    p.add_argument("--secret-env", default="LOCUST_SECRET")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--workload", default="wordcount")
+    p.add_argument("--weight", type=float, default=1.0)
+    p.add_argument("--block-lines", type=int, default=None)
+    p.add_argument("--sort-mode", default=None)
+    p.add_argument("--table-size", type=int, default=None)
+    p.add_argument("--line-width", type=int, default=None)
+    p.add_argument("--key-width", type=int, default=None)
+    p.add_argument("--emits-per-line", type=int, default=None)
+    p.add_argument("--invalidate", action="store_true",
+                   help="drop any cached result for this job first")
+    p.add_argument("--no-wait", action="store_true",
+                   help="print the job id and return without waiting")
+    args = p.parse_args(argv)
+    with open(args.file, "rb") as f:
+        corpus = f.read()
+    config = {
+        k: v
+        for k, v in (
+            ("block_lines", args.block_lines),
+            ("sort_mode", args.sort_mode),
+            ("table_size", args.table_size),
+            ("line_width", args.line_width),
+            ("key_width", args.key_width),
+            ("emits_per_line", args.emits_per_line),
+        )
+        if v is not None
+    }
+    client = _client(args)
+    ack = client.submit(
+        corpus=corpus, tenant=args.tenant, workload=args.workload,
+        config=config or None, weight=args.weight,
+        invalidate=args.invalidate,
+    )
+    print(f"[serve] job {ack['job_id']} {ack['state']}"
+          + (" (cached)" if ack.get("cached") else ""), file=sys.stderr)
+    if args.no_wait:
+        print(ack["job_id"])
+        return 0
+    _print_result(client.wait(ack["job_id"]))
+    return 0
+
+
+def _print_result(res: dict) -> None:
+    for k, v in sorted(res["pairs"]):
+        sys.stdout.buffer.write(k + b"\t" + str(v).encode() + b"\n")
+    print(
+        f"[serve] {res['distinct']} distinct, cache={res['cache']}, "
+        f"latency {res['latency_ms']} ms", file=sys.stderr,
+    )
+
+
+def _result_main(argv) -> int:
+    """Fetch a job submitted earlier with ``--no-wait`` — without this
+    command a detached submit's id would be a dead end the protocol can
+    answer but the CLI cannot."""
+    p = argparse.ArgumentParser(prog="locust-serve result")
+    p.add_argument("job_id", help="id printed by `submit --no-wait`")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1347)
+    p.add_argument("--secret-env", default="LOCUST_SECRET")
+    p.add_argument("--wait", action="store_true",
+                   help="poll until the job finishes instead of "
+                        "answering not_done")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="--wait deadline in seconds")
+    args = p.parse_args(argv)
+    client = _client(args)
+    if args.wait:
+        res = client.wait(args.job_id, timeout=args.timeout)
+    else:
+        res = client.result(args.job_id)
+    _print_result(res)
+    return 0
+
+
+def _stats_main(argv, cmd: str) -> int:
+    p = argparse.ArgumentParser(prog=f"locust-serve {cmd}")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1347)
+    p.add_argument("--secret-env", default="LOCUST_SECRET")
+    args = p.parse_args(argv)
+    client = _client(args)
+    if cmd == "shutdown":
+        client.shutdown()
+        print("[serve] daemon shutting down", file=sys.stderr)
+        return 0
+    print(json.dumps(client.stats(), indent=2, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] in _CLIENT_CMDS:
+        from locust_tpu.serve.client import ServeError
+
+        cmd, rest = argv[0], argv[1:]
+        try:
+            if cmd == "submit":
+                return _submit_main(rest)
+            if cmd == "result":
+                return _result_main(rest)
+            return _stats_main(rest, cmd)
+        except ServeError as e:
+            # A structured daemon answer is an exit code + one line,
+            # never a traceback.
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    return _daemon_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,363 @@
+"""The serve tier's two cache layers + warm-state persistence.
+
+**Warm-executable cache** (``ExecutableCache``): compiled engine programs
+keyed by ``(workload, EngineConfig.fingerprint(), shape bucket)``.  The
+20-40 s TPU compile (CLAUDE.md) is the serve tier's whole reason to
+exist: a repeat job — or ANY job whose corpus rounds into an
+already-compiled shape bucket — must skip compilation.  Buckets round a
+job's block count up a power-of-two ladder (``bucket_blocks``), so small
+jobs of different sizes share one executable at the cost of folding a few
+zero blocks (zero lines emit nothing; identical results by the engine's
+existing padding semantics).  Engines are LRU-bounded: each holds device
+buffers and a jit cache, so an unbounded config zoo would hold the
+accelerator's memory hostage.
+
+**Result cache** (``ResultCache``): finished tables keyed by
+``(corpus digest, spec fingerprint)``.  A repeat of the SAME bytes under
+the SAME program is answered without touching the engine at all.
+Explicit invalidation only (the ``invalidate`` command / submit flag):
+the daemon cannot know when a client's corpus path contents changed
+semantics, so staleness is the client's call — but the key includes the
+corpus sha256, so different BYTES can never alias.
+
+**Warm-state persistence** (``WarmState``): the result cache (and cache
+counters) survive daemon restarts by riding the SAME bounded async
+snapshot machinery the streaming tier trusts (io/snapshot.py):
+``AsyncCheckpointWriter`` latest-wins generations off the dispatch path,
+``finalize_snapshot``'s tmp-write + atomic rename (which also carries the
+``io.ckpt_write``/``io.checkpoint`` chaos sites — the serve warm file is
+chaos-covered for free).  A missing/corrupt/version-skewed warm file
+costs a cold start, never a crash and never a wrong answer (results are
+re-validated by their content-addressed keys).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import threading
+
+from locust_tpu.config import EngineConfig
+from locust_tpu.serve.jobs import WORKLOADS, JobSpec, pairs_bytes
+
+logger = logging.getLogger("locust_tpu")
+
+# Warm-file format version: bumped on layout changes so an old daemon's
+# file is a clean cold start for a new one, not a parse crash.
+WARM_VERSION = 1
+WARM_FILE = "serve_warm.json"
+
+
+def bucket_blocks(n_blocks: int) -> int:
+    """Shape-bucket ladder: block counts round UP to the next power of
+    two, so jobs of nearby sizes share one compiled executable (the
+    padding cost is bounded by <2x blocks, and padded blocks are all-NUL
+    rows the map stage emits nothing for)."""
+    n = max(1, int(n_blocks))
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _resolve_workload(name: str):
+    """Lazy map-fn import (jax enters the process here, not at module
+    import): 'pkg.mod:attr' -> (map_fn, combine)."""
+    path, combine = WORKLOADS[name]
+    mod_name, _, attr = path.partition(":")
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr), combine
+
+
+class ExecutableCache:
+    """Warm engines + compiled-shape tracking, hit/miss accounted.
+
+    A LOOKUP is a hit iff the engine for ``(workload, cfg fingerprint)``
+    exists AND the exact batched dispatch shape ``(njobs, bucket)`` has
+    run before (jax's jit cache then reuses the compiled executable — no
+    trace, no compile).  Anything else is a miss that pays the build
+    and/or compile; the stats make the distinction auditable
+    (tests/test_serve.py pins that a repeat job reports ``compiles``
+    unchanged).
+    """
+
+    def __init__(self, max_engines: int = 4):
+        if max_engines < 1:
+            raise ValueError("max_engines must be >= 1")
+        self.max_engines = max_engines
+        self._lock = threading.Lock()
+        self._engines: dict[tuple, object] = {}  # key -> engine (LRU order)
+        self._shapes: set[tuple] = set()         # (key, njobs, bucket)
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0     # engines constructed
+        self.compiles = 0   # batched shapes first-dispatched
+        self.evictions = 0
+
+    @staticmethod
+    def engine_key(spec: JobSpec) -> tuple:
+        return (spec.workload, spec.cfg.fingerprint())
+
+    def lookup(self, spec: JobSpec, njobs: int, bucket: int):
+        """(engine, hit) — builds the engine on a miss; the SHAPE is
+        marked compiled only by ``mark_compiled`` after the dispatch ran
+        (a dispatch that dies must not poison the ledger as warm)."""
+        key = self.engine_key(spec)
+        with self._lock:
+            eng = self._engines.pop(key, None)
+            if eng is not None:
+                self._engines[key] = eng  # LRU touch
+                if (key, njobs, bucket) in self._shapes:
+                    self.hits += 1
+                    return eng, True
+                self.misses += 1
+                return eng, False
+            self.misses += 1
+        # Build OUTSIDE the lock: engine construction imports/compiles
+        # nothing device-side yet, but it is not free and must not block
+        # concurrent lookups of already-warm keys.
+        from locust_tpu.engine import MapReduceEngine
+
+        map_fn, combine = _resolve_workload(spec.workload)
+        built = MapReduceEngine(spec.cfg, map_fn=map_fn, combine=combine)
+        with self._lock:
+            eng = self._engines.get(key)
+            if eng is None:  # we won the (benign) build race
+                eng = built
+                self._engines[key] = eng
+                self.builds += 1
+                while len(self._engines) > self.max_engines:
+                    evicted_key = next(iter(self._engines))
+                    self._engines.pop(evicted_key)
+                    self._shapes = {
+                        s for s in self._shapes if s[0] != evicted_key
+                    }
+                    self.evictions += 1
+            return eng, False
+
+    def mark_compiled(self, spec: JobSpec, njobs: int, bucket: int) -> None:
+        with self._lock:
+            key = (self.engine_key(spec), njobs, bucket)
+            if key not in self._shapes:
+                self._shapes.add(key)
+                self.compiles += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "engines": len(self._engines),
+                "shapes": len(self._shapes),
+                "hits": self.hits,
+                "misses": self.misses,
+                "builds": self.builds,
+                "compiles": self.compiles,
+                "evictions": self.evictions,
+            }
+
+
+class ResultCache:
+    """Finished tables keyed by (corpus sha256, spec fingerprint)."""
+
+    def __init__(self, max_entries: int = 256,
+                 max_bytes: int = 256 << 20):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = max_entries
+        # Entry COUNT alone cannot bound memory: 256 entries of
+        # multi-MB pair lists is GBs of retention, the same
+        # overload-must-reject-not-OOM class as the daemon's queue
+        # byte cap.  LRU eviction runs on whichever cap trips first.
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: dict[tuple[str, str], dict] = {}  # LRU order
+        self._bytes = 0  # sum of entry "bytes" estimates
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def get(self, digest: str, spec_fp: str) -> list | None:
+        hit = self.get_with_meta(digest, spec_fp)
+        return None if hit is None else hit[0]
+
+    def get_with_meta(
+        self, digest: str, spec_fp: str
+    ) -> tuple[list, dict] | None:
+        """(pairs, meta) on a hit — meta carries the ORIGINAL run's
+        distinct/truncated/overflow_tokens so a replayed lossy result
+        stays flagged lossy (daemon submit path)."""
+        with self._lock:
+            ent = self._entries.pop((digest, spec_fp), None)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries[(digest, spec_fp)] = ent  # LRU touch
+            ent["hits"] += 1
+            self.hits += 1
+            return ent["pairs"], dict(ent["meta"])
+
+    def put(self, digest: str, spec_fp: str, pairs: list,
+            meta: dict | None = None) -> None:
+        size = pairs_bytes(pairs)
+        with self._lock:
+            old = self._entries.pop((digest, spec_fp), None)
+            if old is not None:
+                self._bytes -= old["bytes"]
+            self._entries[(digest, spec_fp)] = {
+                "pairs": list(pairs),
+                "bytes": size,
+                "hits": 0,
+                "meta": dict(meta or {}),
+            }
+            self._bytes += size
+            while (len(self._entries) > self.max_entries
+                   or self._bytes > self.max_bytes):
+                if len(self._entries) == 1:
+                    break  # a single oversized entry still serves hits
+                ent = self._entries.pop(next(iter(self._entries)))
+                self._bytes -= ent["bytes"]
+
+    def invalidate(self, digest: str | None = None,
+                   spec_fp: str | None = None) -> int:
+        """Drop matching entries (both None = everything); returns count."""
+        with self._lock:
+            doomed = [
+                k for k in self._entries
+                if (digest is None or k[0] == digest)
+                and (spec_fp is None or k[1] == spec_fp)
+            ]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k)["bytes"]
+            self.invalidations += len(doomed)
+            return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations,
+            }
+
+    # ------------------------------------------------- (de)serialization
+
+    def dump(self) -> list[dict]:
+        # Shallow snapshot under the lock, base64 OUTSIDE it: the encode
+        # is O(total cached pairs) and must not stall concurrent lookups
+        # (pairs lists are never mutated after put(), so reading them
+        # lock-free is safe).
+        with self._lock:
+            snapshot = [
+                (k, ent["pairs"], dict(ent["meta"]))
+                for k, ent in self._entries.items()
+            ]
+        return [
+            {
+                "digest": k[0],
+                "spec_fp": k[1],
+                "pairs": [
+                    [base64.b64encode(key).decode(), int(v)]
+                    for key, v in pairs
+                ],
+                "meta": meta,
+            }
+            for k, pairs, meta in snapshot
+        ]
+
+    def load(self, rows: list[dict]) -> int:
+        n = 0
+        for row in rows:
+            try:
+                pairs = [
+                    (base64.b64decode(k), int(v)) for k, v in row["pairs"]
+                ]
+                self.put(str(row["digest"]), str(row["spec_fp"]), pairs,
+                         meta=row.get("meta"))
+                n += 1
+            except (KeyError, TypeError, ValueError) as e:
+                # One rotten entry must not cost the warm start.
+                logger.warning("serve warm entry skipped (%s)", e)
+        return n
+
+
+class WarmState:
+    """Persist the result cache across daemon restarts, asynchronously.
+
+    ``mark(generation)`` hands a serialize-closure to the bounded
+    latest-wins ``AsyncCheckpointWriter`` (io/snapshot.py) — the dispatch
+    loop never blocks on disk; ``finalize_snapshot`` publishes atomically
+    through the existing ``io.ckpt_write``/``io.checkpoint`` chaos sites.
+    ``load()`` at daemon startup restores entries; any failure is a cold
+    start, logged, never fatal.
+    """
+
+    def __init__(self, warm_dir: str, results: ResultCache):
+        # Lazy: locust_tpu.io pulls jax in via serde at package import,
+        # and this module must stay importable without it — the thin
+        # client (submit/stats/shutdown against a remote daemon) must
+        # not pay a jax init, which can HANG on a wedged axon tunnel
+        # (CLAUDE.md).  Only the daemon constructs a WarmState.
+        from locust_tpu.io.snapshot import (
+            AsyncCheckpointWriter,
+            finalize_snapshot,
+        )
+
+        self._finalize_snapshot = finalize_snapshot
+        self.path = os.path.join(warm_dir, WARM_FILE)
+        self._results = results
+        os.makedirs(warm_dir, exist_ok=True)
+        self._writer = AsyncCheckpointWriter(name="serve-warm-writer")
+
+    def load(self) -> int:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except OSError:
+            return 0  # no warm file: cold start
+        except ValueError as e:
+            logger.warning(
+                "serve warm state %s unreadable (%s); cold start",
+                self.path, e,
+            )
+            return 0
+        if not isinstance(doc, dict) or doc.get("version") != WARM_VERSION:
+            logger.warning(
+                "serve warm state %s has version %r (want %d); cold start",
+                self.path, getattr(doc, "get", lambda _: None)("version"),
+                WARM_VERSION,
+            )
+            return 0
+        n = self._results.load(doc.get("results") or [])
+        logger.info("serve warm state: restored %d cached result(s)", n)
+        return n
+
+    def mark(self, generation: int) -> None:
+        # The whole serialize — dump() included — runs in the write
+        # closure ON THE WRITER THREAD: encoding every cached pair is
+        # O(total cached bytes) and would otherwise bill the dispatch
+        # loop this layer promises never to block.  The file then
+        # carries the cache state at WRITE time (fresher than mark time,
+        # which is fine: it is a cache, and latest-wins already skips
+        # lapped generations).
+        def write():
+            doc = {"version": WARM_VERSION, "generation": generation,
+                   "results": self._results.dump()}
+            tmp = f"{self.path}.tmp.{generation}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            self._finalize_snapshot(tmp, self.path, generation=generation)
+
+        self._writer.submit(generation, write)
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def stats(self) -> dict:
+        return dict(self._writer.stats(), path=self.path)

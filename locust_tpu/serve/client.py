@@ -1,0 +1,145 @@
+"""Thin client for the serve daemon (serve/daemon.py).
+
+Speaks the distributor's authenticated frame protocol over one fresh
+connection per request — stateless and retry-friendly (the daemon's
+replay guard wants fresh nonces anyway; a persistent connection buys
+nothing at control-plane request sizes).  ``ServeError`` carries the
+daemon's structured reason code so callers can switch on ``code``
+(``queue_full`` -> back off, ``bad_spec`` -> fix the request, ...).
+"""
+
+from __future__ import annotations
+
+import base64
+import socket
+import time
+
+from locust_tpu.distributor import protocol
+from locust_tpu.utils import faultplan
+
+
+class ServeError(RuntimeError):
+    """A structured daemon-side error; ``code`` is an ERROR_CODES entry."""
+
+    def __init__(self, code: str, message: str, reply: dict | None = None):
+        self.code = code
+        self.reply = reply or {}
+        super().__init__(f"[{code}] {message}")
+
+
+class ServeClient:
+    def __init__(
+        self,
+        addr: tuple[str, int],
+        secret: bytes,
+        timeout: float = 60.0,
+    ):
+        self.addr = (addr[0], int(addr[1]))
+        self.secret = secret
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ plumbing
+
+    def rpc(self, req: dict) -> dict:
+        faultplan.check_connect(self.addr[0], self.addr[1])
+        with socket.create_connection(self.addr, timeout=self.timeout) as s:
+            s.settimeout(self.timeout)
+            protocol.send_frame(s, req, self.secret)
+            return protocol.recv_frame(s, self.secret)
+
+    def _rpc_ok(self, req: dict) -> dict:
+        resp = self.rpc(req)
+        if resp.get("status") != "ok":
+            raise ServeError(
+                str(resp.get("code", "dispatch_failed")),
+                str(resp.get("error", "serve request failed")),
+                reply=resp,
+            )
+        return resp
+
+    # ------------------------------------------------------------ commands
+
+    def ping(self) -> bool:
+        return bool(self._rpc_ok({"cmd": "ping"}).get("pong"))
+
+    def submit(
+        self,
+        corpus: bytes | None = None,
+        path: str | None = None,
+        tenant: str = "default",
+        workload: str = "wordcount",
+        config: dict | None = None,
+        weight: float = 1.0,
+        invalidate: bool = False,
+        no_cache: bool = False,
+    ) -> dict:
+        """Submit one job; returns the daemon's ack ({job_id, state,
+        cached}).  Raises ``ServeError`` on a structured rejection."""
+        req: dict = {
+            "cmd": "submit",
+            "tenant": tenant,
+            "workload": workload,
+            "weight": weight,
+        }
+        if config:
+            req["config"] = dict(config)
+        if invalidate:
+            req["invalidate"] = True
+        if no_cache:
+            req["no_cache"] = True
+        if corpus is not None:
+            req["corpus_b64"] = base64.b64encode(corpus).decode()
+        if path is not None:
+            req["path"] = path
+        return self._rpc_ok(req)
+
+    def status(self, job_id: str) -> dict:
+        return self._rpc_ok({"cmd": "status", "job_id": job_id})
+
+    def result(self, job_id: str) -> dict:
+        """The finished job's decoded result: the reply dict with
+        ``pairs`` as (key bytes, count) tuples.  Raises ``ServeError``
+        with the job's structured code on failed/cancelled/not-done."""
+        resp = self._rpc_ok({"cmd": "result", "job_id": job_id})
+        resp["pairs"] = [
+            (base64.b64decode(k), int(v)) for k, v in resp.get("pairs", [])
+        ]
+        return resp
+
+    def wait(self, job_id: str, timeout: float = 120.0,
+             poll_s: float = 0.05) -> dict:
+        """Poll until the job leaves the queue/engine; returns
+        ``result()`` on success, raises ``ServeError`` on a structured
+        failure or ``TimeoutError`` when the deadline passes (a bounded
+        wait — a wedged daemon must not hang the client)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self.status(job_id)
+            if st["state"] in ("done", "failed", "cancelled"):
+                return self.result(job_id)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {st['state']} after {timeout}s"
+                )
+            time.sleep(poll_s)
+
+    def cancel(self, job_id: str) -> dict:
+        return self._rpc_ok({"cmd": "cancel", "job_id": job_id})
+
+    def invalidate(self, digest: str | None = None,
+                   spec_fp: str | None = None,
+                   job_id: str | None = None) -> int:
+        req: dict = {"cmd": "invalidate"}
+        if digest:
+            req["digest"] = digest
+        if spec_fp:
+            req["spec_fp"] = spec_fp
+        if job_id:
+            req["job_id"] = job_id
+        return int(self._rpc_ok(req).get("invalidated", 0))
+
+    def stats(self) -> dict:
+        return self._rpc_ok({"cmd": "stats"})
+
+    def shutdown(self) -> bool:
+        return bool(self._rpc_ok({"cmd": "shutdown"}).get("bye"))

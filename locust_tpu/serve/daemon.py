@@ -1,0 +1,778 @@
+"""Locust Serve: the persistent multi-tenant engine daemon.
+
+The one-shot CLI pays full cold start on every run — process spawn,
+backend probe, 20-40 s TPU compile, cold caches (CLAUDE.md).  This daemon
+keeps ONE process resident and serves many concurrent jobs against warm
+compiled executables (docs/SERVING.md):
+
+  * **protocol**: the distributor's authenticated length-prefixed frames
+    (distributor/protocol.py — HMAC, replay guard, the same negotiation
+    stance), with a serve-specific closed command set::
+
+        submit | status | result | cancel | invalidate | stats
+        | ping | shutdown
+
+  * **admission + fairness**: a bounded queue that rejects-with-reason
+    when full and a per-tenant weighted fair scheduler
+    (serve/scheduler.py) so one heavy tenant cannot starve the rest;
+  * **warm-executable cache**: compiled programs keyed by (workload,
+    EngineConfig fingerprint, shape bucket) — repeat jobs skip
+    compilation (serve/cache.py);
+  * **shape-bucketed batching**: compatible queued jobs coalesce into one
+    vmapped engine dispatch and demultiplex per-job results
+    (serve/batch.py, engine.run_batch);
+  * **result cache**: (corpus digest, job spec) -> finished table, with
+    explicit invalidation, persisted across restarts through the async
+    snapshot writer (serve/cache.WarmState -> io/snapshot.py).
+
+Error discipline (pinned by the chaos matrix, tests/test_faults.py): a
+client observes either a correct result or a STRUCTURED error carrying a
+``jobs.ERROR_CODES`` reason — never a silent wrong answer.  The
+``serve.admit`` and ``serve.dispatch`` fault sites (utils/faultplan.py)
+inject failures at the admission and dispatch boundaries to keep that
+claim honest.
+
+Telemetry (docs/OBSERVABILITY.md): per-job phases land as ``serve.*``
+spans — queue wait, compile-or-hit, dispatch, demux — plus admission
+events and latency/cache metrics, all in the closed obs registry (R009).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import logging
+import socket
+import threading
+import time
+import uuid
+
+from locust_tpu import obs
+from locust_tpu.distributor import protocol
+from locust_tpu.serve import batch as batching
+from locust_tpu.serve.cache import (
+    ExecutableCache,
+    ResultCache,
+    WarmState,
+)
+from locust_tpu.serve.jobs import (
+    Job,
+    parse_spec,
+    structured_error,
+)
+from locust_tpu.serve.jobs import pairs_bytes as jobs_pairs_bytes
+from locust_tpu.serve.scheduler import AdmitReject, FairScheduler
+from locust_tpu.utils import faultplan
+
+logger = logging.getLogger("locust_tpu")
+
+SERVE_COMMANDS = (
+    "ping", "submit", "status", "result", "cancel", "invalidate",
+    "stats", "shutdown",
+)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Daemon capacity/policy knobs (docs/SERVING.md)."""
+
+    max_queue: int = 64          # admission bound: pending jobs, global
+    max_batch: int = 8           # jobs coalesced into one dispatch
+    tenant_quota: int | None = 32  # pending jobs per tenant (None = off)
+    max_engines: int = 4         # warm engines kept (LRU)
+    max_results: int = 256       # result-cache entries kept (LRU)
+    max_result_bytes: int = 256 << 20  # result-cache aggregate byte cap
+    # Aggregate cap on result payloads retained by FINISHED job records
+    # (max_history bounds record COUNT; 1024 records of multi-MB pairs
+    # would be GBs of RSS).  Past it the oldest finished records are
+    # evicted whole — a later result fetch reads unknown_job, exactly
+    # like the existing count-cap eviction.
+    max_history_bytes: int = 256 << 20
+    max_corpus_bytes: int = 16 << 20  # inline submit payload cap
+    # Aggregate cap on ALL buffered in-flight corpora: max_queue bounds
+    # job COUNT, but max_queue * max_corpus_bytes of buffered bytes
+    # (1 GiB at defaults) is an OOM, and overload must become a
+    # structured rejection, not a dead daemon.
+    max_queue_bytes: int = 256 << 20
+    warm_dir: str | None = None  # persist warm state here (None = off)
+    warm_every: int = 8          # warm-state generation cadence (jobs)
+    max_history: int = 1024      # finished jobs kept for status/result
+    conn_timeout: float = 30.0
+    max_connections: int = 32
+    dispatch_poll_s: float = 0.25  # dispatcher wake cadence when idle
+
+
+class ServeDaemon:
+    """One serve daemon: accept loop + single dispatcher thread.
+
+    Maps serialize through the ONE dispatcher (the node has one
+    accelerator — same stance as the distributor worker's map lock);
+    handler threads only touch the queue, the caches, and job records.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: bytes = b"",
+        cfg: ServeConfig | None = None,
+    ):
+        if not secret:
+            raise ValueError("serve daemon requires a shared secret "
+                             "(same Q8 stance as the distributor)")
+        self.secret = secret
+        self.cfg = cfg or ServeConfig()
+        self.scheduler = FairScheduler(
+            max_queue=self.cfg.max_queue,
+            max_batch=self.cfg.max_batch,
+            tenant_quota=self.cfg.tenant_quota,
+        )
+        self.executables = ExecutableCache(max_engines=self.cfg.max_engines)
+        self.results = ResultCache(
+            max_entries=self.cfg.max_results,
+            max_bytes=self.cfg.max_result_bytes,
+        )
+        self.warm = (
+            WarmState(self.cfg.warm_dir, self.results)
+            if self.cfg.warm_dir
+            else None
+        )
+        if self.warm is not None:
+            self.warm.load()
+        self._lock = threading.Lock()
+        self._jobs: dict[str, Job] = {}       # insertion order = age
+        self._corpus_bytes: dict[str, bytes] = {}  # job_id -> in-flight bytes
+        self._corpus_total = 0  # sum of _corpus_bytes values (admission cap)
+        self._result_bytes = 0  # sum of retained job.result_bytes (history cap)
+        self._completed = 0
+        self._warm_marked = 0  # completed-count at the last warm mark
+        self._started_s = time.monotonic()
+        self._replay_guard = protocol.ReplayGuard()
+        self._conn_slots = threading.BoundedSemaphore(self.cfg.max_connections)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(5)
+        self.addr = self._sock.getsockname()
+        self._shutdown = threading.Event()
+        self._closed = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+
+    # --------------------------------------------------------- accept loop
+
+    def serve_forever(self) -> None:
+        # try/finally, not loop-exit cleanup: a KeyboardInterrupt in the
+        # foreground CLI lands inside accept() and would otherwise skip
+        # close() — losing the final warm-state flush the --warm-dir
+        # flag promises (close() is idempotent, so the shutdown-command
+        # path calling through here again is safe).
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    self._sock.settimeout(0.5)
+                    conn, _peer = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                # Bounded acquire: a plain acquire() with all slots held
+                # by slow peers would wedge this loop PAST the shutdown
+                # check — neither a shutdown command nor close() could
+                # ever land.
+                acquired = False
+                while not self._shutdown.is_set():
+                    if self._conn_slots.acquire(timeout=0.5):
+                        acquired = True
+                        break
+                if not acquired:
+                    conn.close()
+                    continue
+                threading.Thread(
+                    target=self._serve_one, args=(conn,), daemon=True
+                ).start()
+        finally:
+            self._sock.close()
+            self.close()
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def close(self) -> None:
+        """Stop the dispatcher and flush warm state.  Idempotent and
+        race-safe: the accept loop's exit path and an operator teardown
+        may both call it (first caller wins the warm flush)."""
+        self._shutdown.set()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            gen = self._completed
+        self.scheduler.stop()
+        # The join must outlive one TPU cold compile (20-40s per
+        # CLAUDE.md): a shorter timeout lets close() flush + close the
+        # warm writer while a dispatch is mid-compile, so that batch's
+        # late warm.mark hits a closed writer and its jobs silently
+        # miss the persisted state.
+        self._dispatcher.join(timeout=90.0)
+        if self._dispatcher.is_alive():
+            logger.warning(
+                "serve dispatcher still busy after 90s at close; jobs "
+                "finishing after this point will not reach warm state"
+            )
+        # The stopped scheduler answers next_batch with None forever, so
+        # jobs still queued here can never dispatch: fail them with the
+        # structured shutdown code and free their buffered corpora
+        # instead of abandoning them in state "queued" — an accepted job
+        # must end in a result or a reason code, even at teardown.
+        stranded = self.scheduler.drain()
+        if stranded:
+            with self._lock:
+                for job in stranded:
+                    self._corpus_pop(job.job_id)
+            self._fail_batch(stranded, structured_error(
+                "shutting_down",
+                "daemon shut down before this job was dispatched; "
+                "resubmit after it returns",
+            ))
+        if self.warm is not None:
+            try:
+                self.warm.mark(gen + 1)  # final generation: latest results
+            except Exception:  # noqa: BLE001 - a failed PRIOR background
+                # write re-raises at the next submit (io/snapshot.py);
+                # the flush is best-effort at shutdown and must not
+                # leave the writer thread unjoined (close is guarded by
+                # _closed, so an escape here is permanently unretryable).
+                logger.exception("serve final warm mark failed")
+            self.warm.close()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        finally:
+            self._conn_slots.release()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while not self._shutdown.is_set():
+                    try:
+                        conn.settimeout(self.cfg.conn_timeout)
+                        req = protocol.recv_frame(conn, self.secret)
+                    except PermissionError:
+                        return  # unauthenticated peer: drop silently
+                    except (ConnectionError, socket.timeout, OSError):
+                        return  # peer closed / idled out
+                    except Exception as e:
+                        self._try_reply(
+                            conn, structured_error("bad_spec", str(e))
+                        )
+                        return
+                    try:
+                        self._replay_guard.check(req)
+                        resp = self._handle(req)
+                    except PermissionError:
+                        return  # replayed frame: drop silently
+                    except Exception as e:  # noqa: BLE001 - daemon survives
+                        resp = structured_error(
+                            "dispatch_failed", f"{type(e).__name__}: {e}"
+                        )
+                    if not self._try_reply(conn, resp):
+                        return
+        except Exception:  # noqa: BLE001 - connection threads never die loud
+            logger.exception("serve connection handler failed")
+
+    def _try_reply(self, conn: socket.socket, resp: dict) -> bool:
+        try:
+            protocol.send_frame(conn, resp, self.secret, sign_fresh=False)
+            return True
+        except protocol.FrameTooLarge as e:
+            # Raised BEFORE any bytes hit the wire (send_frame sizes the
+            # whole frame first), so the connection is still clean:
+            # answer with a small structured error instead of dropping
+            # the peer — a completed job whose result JSON exceeds
+            # MAX_FRAME would otherwise be permanently unfetchable
+            # through bare ConnectionErrors, against the tier's
+            # correct-result-or-structured-error guarantee.
+            err = structured_error(
+                "result_too_large",
+                f"reply frame exceeds protocol.MAX_FRAME "
+                f"({protocol.MAX_FRAME} bytes): {e}; lower table_size "
+                "or split the corpus",
+            )
+            try:
+                protocol.send_frame(
+                    conn, err, self.secret, sign_fresh=False
+                )
+                return True
+            except (protocol.ProtocolError, OSError):
+                return False
+        except OSError:
+            return False
+
+    # ----------------------------------------------------------- commands
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd not in SERVE_COMMANDS:
+            return structured_error(
+                "unknown_command",
+                f"unknown command {cmd!r} (serve speaks {SERVE_COMMANDS})",
+            )
+        if cmd == "ping":
+            return {"status": "ok", "pong": True, "service": "locust-serve"}
+        if cmd == "shutdown":
+            self._shutdown.set()
+            return {"status": "ok", "bye": True}
+        if cmd == "submit":
+            return self._cmd_submit(req)
+        if cmd == "status":
+            return self._cmd_status(req)
+        if cmd == "result":
+            return self._cmd_result(req)
+        if cmd == "cancel":
+            return self._cmd_cancel(req)
+        if cmd == "invalidate":
+            return self._cmd_invalidate(req)
+        return self._cmd_stats()
+
+    def _cmd_submit(self, req: dict) -> dict:
+        try:
+            spec, corpus = parse_spec(
+                req, max_corpus_bytes=self.cfg.max_corpus_bytes
+            )
+        except ValueError as e:
+            code, _, msg = str(e).partition("\n")
+            obs.event("serve.reject", code=code)
+            return structured_error(code, msg or code)
+        if len(corpus) > self.cfg.max_corpus_bytes:
+            obs.event("serve.reject", code="corpus_too_large")
+            return structured_error(
+                "corpus_too_large",
+                f"inline corpus of {len(corpus)} bytes exceeds the "
+                f"daemon cap ({self.cfg.max_corpus_bytes}); stream it "
+                "through a server-side path instead",
+            )
+        # Chaos: the admission boundary (docs/FAULTS.md).  "error" models
+        # an admission subsystem failure — the client gets a structured
+        # rejection and may retry; "delay" models admission contention.
+        rule = faultplan.fire(
+            "serve.admit", tenant=spec.tenant, workload=spec.workload
+        )
+        if rule is not None:
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            else:
+                obs.event("serve.reject", code="fault_injected")
+                return structured_error(
+                    "fault_injected",
+                    "[faultplan] injected admission failure — retry",
+                )
+        digest = hashlib.sha256(corpus).hexdigest()
+        spec_fp = spec.fingerprint()
+        n_lines = batching.count_lines(corpus)
+        n_blocks, bucket = batching.job_shape(n_lines, spec.cfg)
+        job = Job(
+            job_id=uuid.uuid4().hex[:12],
+            spec=spec,
+            corpus_digest=digest,
+            n_lines=n_lines,
+            n_blocks=n_blocks,
+            bucket=bucket,
+        )
+        if not spec.no_cache and not spec.invalidate:
+            hit = self.results.get_with_meta(digest, spec_fp)
+            if hit is not None:
+                # Served straight from the result cache: no queue, no
+                # engine.  The job record still exists so status/result
+                # work uniformly.  The ORIGINAL run's truncation flags
+                # replay with the pairs — a lossy result must stay
+                # flagged lossy on every replay, or the cache hit would
+                # be the silent wrong answer this tier forbids.
+                pairs, meta = hit
+                job.state = "done"
+                job.cache = "result"
+                job.started_s = job.submitted_s
+                job.finished_s = time.monotonic()
+                job.result = pairs
+                job.result_bytes = jobs_pairs_bytes(pairs)
+                job.distinct = int(meta.get("distinct", len(pairs)))
+                job.truncated = bool(meta.get("truncated", False))
+                job.overflow_tokens = int(meta.get("overflow_tokens", 0))
+                with self._lock:
+                    self._result_bytes += job.result_bytes
+                    self._remember(job)
+                    self._completed += 1
+                obs.metric_inc("serve.result_cache_hits")
+                obs.metric_inc("serve.jobs")
+                obs.metric_observe("serve.latency_ms", job.latency_ms())
+                return {
+                    "status": "ok", "job_id": job.job_id,
+                    "state": "done", "cached": True,
+                }
+        # Record the job + its bytes BEFORE admit: admit() wakes the
+        # dispatcher, which may pop the job immediately — if the corpus
+        # landed after, the dispatch would fold an empty stack and hand
+        # the client a silently-empty "done" (the exact wrong answer
+        # this tier promises never to produce).
+        with self._lock:
+            over = (
+                self._corpus_total + len(corpus)
+                > self.cfg.max_queue_bytes
+            )
+            if not over:
+                self._remember(job)
+                self._corpus_put(job.job_id, corpus)
+        if over:
+            self.scheduler.count_rejection()
+            obs.event("serve.reject", code="queue_full")
+            return structured_error(
+                "queue_full",
+                f"buffered corpus bytes at cap "
+                f"({self.cfg.max_queue_bytes}); retry with backoff",
+            )
+        try:
+            self.scheduler.admit(job)
+        except AdmitReject as e:
+            with self._lock:
+                self._jobs.pop(job.job_id, None)
+                self._corpus_pop(job.job_id)
+            obs.event("serve.reject", code=e.code)
+            return structured_error(e.code, str(e))
+        if spec.invalidate:
+            # Only AFTER admission succeeds: a rejected submit must have
+            # no side effects — wiping before admission let one tenant's
+            # queue_full request destroy the cached entry every other
+            # tenant was being served from.  (The cache-hit check above
+            # already skips lookups for invalidate submits, so this job
+            # recomputes either way.)
+            self.results.invalidate(digest=digest, spec_fp=spec_fp)
+        obs.event(
+            "serve.admit",
+            job=job.job_id, tenant=spec.tenant, bucket=bucket,
+        )
+        return {
+            "status": "ok", "job_id": job.job_id,
+            "state": "queued", "cached": False,
+        }
+
+    def _remember(self, job: Job) -> None:
+        """Record a job, then evict past the history caps.  Caller
+        holds self._lock."""
+        self._jobs[job.job_id] = job
+        self._evict_history(keep=job.job_id)
+
+    def _evict_history(self, keep: str | None = None) -> None:
+        """Evict the OLDEST FINISHED records while over the history
+        count cap OR the aggregate retained-result byte cap
+        (queued/running records are live state, never evicted).
+        ``keep`` is the job whose completion triggered this call: it
+        must survive even when its result alone overflows the byte cap,
+        or a job could be evicted between its own done-ack and the
+        client's result fetch (same stance as ResultCache keeping a
+        single oversized entry).  Caller holds self._lock."""
+
+        def over() -> bool:
+            return (len(self._jobs) > self.cfg.max_history
+                    or self._result_bytes > self.cfg.max_history_bytes)
+
+        if not over():
+            return
+        for jid, j in list(self._jobs.items()):
+            if not over():
+                break
+            if jid != keep and j.state in ("done", "failed", "cancelled"):
+                del self._jobs[jid]
+                self._corpus_pop(jid)
+                self._result_bytes -= j.result_bytes
+
+    def _job(self, req: dict) -> Job | None:
+        with self._lock:
+            return self._jobs.get(str(req.get("job_id", "")))
+
+    def _corpus_put(self, job_id: str, data: bytes) -> None:
+        """Buffer one job's corpus; caller holds self._lock."""
+        self._corpus_bytes[job_id] = data
+        self._corpus_total += len(data)
+
+    def _corpus_pop(self, job_id: str) -> bytes | None:
+        """Drop one job's buffered corpus; caller holds self._lock."""
+        data = self._corpus_bytes.pop(job_id, None)
+        if data is not None:
+            self._corpus_total -= len(data)
+        return data
+
+    def _cmd_status(self, req: dict) -> dict:
+        job = self._job(req)
+        if job is None:
+            return structured_error(
+                "unknown_job", f"no job {req.get('job_id')!r}"
+            )
+        return {"status": "ok", **job.public()}
+
+    def _cmd_result(self, req: dict) -> dict:
+        import base64
+
+        job = self._job(req)
+        if job is None:
+            return structured_error(
+                "unknown_job", f"no job {req.get('job_id')!r}"
+            )
+        if job.state == "failed":
+            err = job.error or structured_error(
+                "dispatch_failed", "job failed"
+            )
+            return dict(err, job_id=job.job_id, state="failed")
+        if job.state == "cancelled":
+            return structured_error(
+                "cancelled", f"job {job.job_id} was cancelled"
+            )
+        if job.state != "done":
+            return dict(
+                structured_error(
+                    "not_done", f"job {job.job_id} is {job.state}"
+                ),
+                state=job.state,
+            )
+        return {
+            "status": "ok",
+            "job_id": job.job_id,
+            "state": "done",
+            "cache": job.cache,
+            "distinct": job.distinct,
+            "truncated": job.truncated,
+            "overflow_tokens": job.overflow_tokens,
+            "latency_ms": job.latency_ms(),
+            "pairs": [
+                [base64.b64encode(k).decode(), int(v)]
+                for k, v in (job.result or [])
+            ],
+        }
+
+    def _cmd_cancel(self, req: dict) -> dict:
+        job = self._job(req)
+        if job is None:
+            return structured_error(
+                "unknown_job", f"no job {req.get('job_id')!r}"
+            )
+        popped = self.scheduler.cancel(job.job_id)
+        if popped is not None:
+            with self._lock:
+                job.state = "cancelled"
+                job.finished_s = time.monotonic()
+                job.error = structured_error(
+                    "cancelled", "cancelled while queued"
+                )
+                self._corpus_pop(job.job_id)
+            return {"status": "ok", "cancelled": True, "state": "cancelled"}
+        # Running/finished jobs are past the point of no return — report
+        # the state, don't pretend.
+        return {"status": "ok", "cancelled": False, "state": job.state}
+
+    def _cmd_invalidate(self, req: dict) -> dict:
+        digest = req.get("digest")
+        spec_fp = req.get("spec_fp")
+        if req.get("job_id"):
+            job = self._job(req)
+            if job is None:
+                # Falling through with (digest, spec_fp) both None hits
+                # ResultCache's wipe-everything match: a typo'd or
+                # history-evicted id would silently destroy EVERY
+                # tenant's cached results and still answer "ok".
+                return structured_error(
+                    "unknown_job", f"no job {req.get('job_id')!r}"
+                )
+            digest = job.corpus_digest
+            spec_fp = job.spec.fingerprint()
+        n = self.results.invalidate(
+            digest=str(digest) if digest else None,
+            spec_fp=str(spec_fp) if spec_fp else None,
+        )
+        return {"status": "ok", "invalidated": n}
+
+    def _cmd_stats(self) -> dict:
+        with self._lock:
+            states: dict[str, int] = {}
+            for j in self._jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+            completed = self._completed
+            corpus_total = self._corpus_total
+            result_bytes = self._result_bytes
+        return {
+            "status": "ok",
+            "service": "locust-serve",
+            "uptime_s": round(time.monotonic() - self._started_s, 3),
+            "completed": completed,
+            "jobs_by_state": states,
+            "queued_corpus_bytes": corpus_total,
+            "history_result_bytes": result_bytes,
+            "queue": self.scheduler.stats(),
+            "exec_cache": self.executables.stats(),
+            "result_cache": self.results.stats(),
+            "warm": self.warm.stats() if self.warm is not None else None,
+        }
+
+    # ----------------------------------------------------------- dispatch
+
+    def _batch_key(self, job: Job):
+        return (self.executables.engine_key(job.spec), job.bucket)
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._dispatch_once()
+            except Exception:  # noqa: BLE001 - the dispatcher must survive
+                logger.exception("serve dispatch iteration failed")
+
+    def _dispatch_once(self) -> None:
+        # Only an occupied queue is worth a queue-wait span: an idle
+        # daemon's poll ticks would bury the timeline in no-op spans.
+        cm = (
+            obs.span("serve.queue_wait")
+            if self.scheduler.depth()
+            else contextlib.nullcontext()
+        )
+        with cm:
+            jobs = self.scheduler.next_batch(
+                self._batch_key, timeout=self.cfg.dispatch_poll_s
+            )
+        if not jobs:
+            return
+        now = time.monotonic()
+        with self._lock:
+            corpora = {}
+            lost = []
+            for j in jobs:
+                j.state = "running"
+                j.started_s = now
+                j.batch_size = len(jobs)
+                # None = the entry is MISSING (an empty submit stores
+                # b"").  A silent b"" default here would fold an all-zero
+                # stack and hand the client an empty "done" — the silent
+                # wrong answer this tier forbids — so a lost entry fails
+                # the job structurally instead.
+                data = self._corpus_pop(j.job_id)
+                if data is None and j.corpus_digest not in corpora:
+                    lost.append(j)
+                else:
+                    if data is not None:
+                        corpora[j.corpus_digest] = data
+        if lost:
+            self._fail_batch(lost, structured_error(
+                "dispatch_failed",
+                "in-flight corpus bytes missing at dispatch (daemon "
+                "bug) — resubmit",
+            ))
+            jobs = [j for j in jobs if j not in lost]
+            if not jobs:
+                return
+        # Chaos: the dispatch boundary (docs/FAULTS.md).  "crash" models
+        # the dispatch dying mid-flight, "error" an engine-side failure:
+        # either way every job in the batch fails with a STRUCTURED
+        # error (never a silent wrong answer) and the daemon lives on.
+        rule = faultplan.fire("serve.dispatch", jobs=len(jobs))
+        if rule is not None:
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            else:
+                self._fail_batch(jobs, structured_error(
+                    "fault_injected",
+                    f"[faultplan] injected dispatch {rule.action}",
+                ))
+                return
+        spec = jobs[0].spec
+        njobs_padded = batching.bucket_blocks(len(jobs))
+        bucket = jobs[0].bucket
+        try:
+            with obs.span(
+                "serve.compile_or_hit",
+                jobs=len(jobs), bucket=bucket,
+            ):
+                engine, hit = self.executables.lookup(
+                    spec, njobs_padded, bucket
+                )
+            # Literal names per branch: the R009 convention — the
+            # analyzer (and the registry) must see every emission site.
+            if hit:
+                obs.metric_inc("serve.exec_cache_hits")
+            else:
+                obs.metric_inc("serve.exec_cache_misses")
+            with obs.span("serve.dispatch", jobs=len(jobs), bucket=bucket):
+                results = batching.dispatch_batch(engine, jobs, corpora)
+            self.executables.mark_compiled(spec, njobs_padded, bucket)
+            # Demux stays INSIDE the failure boundary: to_host_pairs()
+            # is the device->host transfer and can raise (the flapping
+            # TPU tunnel is the documented case) — an escape here would
+            # leave jobs "running" forever, a hang where the tier
+            # promises a structured error.  _fail_batch skips the jobs
+            # already marked done, so a mid-demux failure keeps the
+            # finished results and fails only the rest.
+            with obs.span("serve.demux", jobs=len(jobs)):
+                done = time.monotonic()
+                for job, res in zip(jobs, results):
+                    pairs = res.to_host_pairs()
+                    size = jobs_pairs_bytes(pairs)
+                    with self._lock:
+                        # state flips to "done" LAST: status/result
+                        # handlers read job fields without this lock, so
+                        # the state write is the publish barrier — a
+                        # reader seeing "done" must also see the result
+                        # (done-with-None-result would answer an empty
+                        # pairs list as success).
+                        job.cache = "warm" if hit else "cold"
+                        job.finished_s = done
+                        job.result = pairs
+                        job.result_bytes = size
+                        job.distinct = res.num_segments
+                        job.truncated = bool(res.truncated)
+                        job.overflow_tokens = int(res.overflow_tokens)
+                        job.state = "done"
+                        self._completed += 1
+                        completed = self._completed
+                        self._result_bytes += size
+                        self._evict_history(keep=job.job_id)
+                    if not job.spec.no_cache:
+                        self.results.put(
+                            job.corpus_digest, job.spec.fingerprint(), pairs,
+                            meta={
+                                "distinct": job.distinct,
+                                "truncated": job.truncated,
+                                "overflow_tokens": job.overflow_tokens,
+                            },
+                        )
+                    obs.metric_inc("serve.jobs")
+                    obs.metric_observe("serve.latency_ms", job.latency_ms())
+        except Exception as e:  # noqa: BLE001 - jobs fail, daemon survives
+            logger.exception("serve dispatch failed")
+            self._fail_batch(jobs, structured_error(
+                "dispatch_failed", f"{type(e).__name__}: {e}"
+            ))
+            return
+        if (self.warm is not None
+                and completed - self._warm_marked >= self.cfg.warm_every):
+            # Latest-wins background generation: the dispatcher never
+            # blocks on disk (io/snapshot.py).  Distance-based cadence,
+            # not modulo: ``completed`` advances by batch size here and
+            # by result-cache hits on handler threads, so the dispatcher
+            # may never OBSERVE a multiple of warm_every — a modulo
+            # check could skip marks forever and silently demote the
+            # cadence to "clean shutdown only".
+            self._warm_marked = completed
+            self.warm.mark(completed)
+
+    def _fail_batch(self, jobs: list[Job], error: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for job in jobs:
+                if job.state == "done":
+                    continue  # demuxed before the failure: result stands
+                # error before state: the state write is the lock-free
+                # readers' publish barrier (same rule as the demux path).
+                job.error = dict(error)
+                job.finished_s = now
+                job.state = "failed"

@@ -1,0 +1,235 @@
+"""Job model for the serve tier: specs, runtime records, structured errors.
+
+A JOB is one client-submitted corpus + pipeline configuration; the daemon
+(serve/daemon.py) turns it into exactly one of two outcomes — a correct
+result table or a STRUCTURED error carrying a reason code from the closed
+``ERROR_CODES`` registry below (the chaos-matrix guarantee: never a
+silent wrong answer, docs/SERVING.md).  jax-free at import so the
+scheduler/cache layers and the client stay importable before backend
+selection (same stance as ``locust_tpu.obs``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+
+from locust_tpu.config import EngineConfig
+
+# Job lifecycle (reported verbatim by the ``status`` command):
+#   queued -> running -> done | failed;  queued -> cancelled.
+JOB_STATES = ("queued", "running", "done", "failed", "cancelled")
+
+# Closed reason-code registry for every structured error the daemon can
+# hand a client (same closed-registry stance as faultplan.SITES and the
+# obs NAMES dict): a client can switch on these without parsing prose.
+ERROR_CODES = (
+    "queue_full",        # admission control: the bounded queue is full
+    "tenant_quota",      # admission control: per-tenant pending cap hit
+    "shutting_down",     # daemon is stopping; do NOT retry this address
+    "bad_spec",          # submit payload failed validation
+    "unknown_workload",  # workload name not in WORKLOADS
+    "corpus_too_large",  # inline corpus exceeds the daemon's cap
+    "fault_injected",    # a serve.* chaos rule rejected/killed the job
+    "dispatch_failed",   # the engine dispatch raised; message has detail
+    "cancelled",         # the client cancelled the job while queued
+    "unknown_job",       # status/result/cancel for an id we don't hold
+    "not_done",          # result requested before the job finished
+    "result_too_large",  # reply frame would exceed protocol.MAX_FRAME
+    "unknown_command",   # command outside the serve command set
+)
+
+# workload name -> (map_fn import path resolved lazily in cache.py,
+# combine).  Lazy: resolving here would pull jax into every importer.
+WORKLOADS = {
+    "wordcount": ("locust_tpu.ops.map_stage:wordcount_map", "sum"),
+}
+
+# Engine-config fields a submit may override; everything else keeps the
+# EngineConfig default.  A closed set so a typo'd knob is a loud
+# ``bad_spec``, not a silently-ignored key.
+SPEC_CONFIG_KEYS = (
+    "line_width", "key_width", "emits_per_line", "block_lines",
+    "table_size", "sort_mode",
+)
+
+
+def structured_error(code: str, message: str) -> dict:
+    """The one shape every daemon-side failure reply takes."""
+    if code not in ERROR_CODES:
+        raise ValueError(f"unknown serve error code {code!r}")
+    return {"status": "error", "code": code, "error": message}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """What a client asked for: corpus + workload + pipeline config.
+
+    ``fingerprint()`` identifies the EXECUTABLE the job needs (workload +
+    full EngineConfig identity) — the result cache key adds the corpus
+    digest on top, so "same program" and "same program over the same
+    bytes" are distinct cache tiers (docs/SERVING.md).
+    """
+
+    tenant: str
+    workload: str
+    cfg: EngineConfig
+    weight: float = 1.0
+    invalidate: bool = False  # drop any cached result for this key first
+    no_cache: bool = False    # compute fresh AND don't store the result
+
+    def fingerprint(self) -> str:
+        # Memoized like EngineConfig.fingerprint(): the daemon asks at
+        # submit, dispatch, demux and invalidate, and the spec is frozen.
+        fp = self.__dict__.get("_fingerprint")
+        if fp is None:
+            combine = WORKLOADS[self.workload][1]
+            raw = f"{self.workload}:{combine}:{self.cfg.fingerprint()}"
+            fp = hashlib.sha1(raw.encode()).hexdigest()[:12]
+            object.__setattr__(self, "_fingerprint", fp)
+        return fp
+
+
+def parse_spec(
+    req: dict, max_corpus_bytes: int | None = None
+) -> tuple[JobSpec, bytes]:
+    """Validate one ``submit`` request into (JobSpec, corpus bytes).
+
+    Raises ``ValueError`` whose first line is an ERROR_CODES entry — the
+    daemon maps it straight onto a structured reply.
+    ``max_corpus_bytes`` bounds the path branch's read: the cap must
+    hold BEFORE the bytes land in daemon memory, or a path submit
+    naming a huge server-side file OOMs the daemon ahead of the
+    rejection (inline corpus_b64 is already bounded by the frame cap).
+    """
+    workload = req.get("workload", "wordcount")
+    if workload not in WORKLOADS:
+        raise ValueError(
+            f"unknown_workload\nworkload {workload!r} not in "
+            f"{sorted(WORKLOADS)}"
+        )
+    corpus_b64 = req.get("corpus_b64")
+    path = req.get("path")
+    if (corpus_b64 is None) == (path is None):
+        raise ValueError(
+            "bad_spec\nsubmit needs exactly one of corpus_b64 or path"
+        )
+    if corpus_b64 is not None:
+        import base64
+        import binascii
+
+        try:
+            corpus = base64.b64decode(corpus_b64, validate=True)
+        except (binascii.Error, TypeError, ValueError) as e:
+            raise ValueError(f"bad_spec\ncorpus_b64 does not decode: {e}")
+    else:
+        try:
+            with open(path, "rb") as f:
+                if max_corpus_bytes is None:
+                    corpus = f.read()
+                else:
+                    corpus = f.read(max_corpus_bytes + 1)
+        except OSError as e:
+            raise ValueError(f"bad_spec\ncorpus path unreadable: {e}")
+        if max_corpus_bytes is not None and len(corpus) > max_corpus_bytes:
+            raise ValueError(
+                f"corpus_too_large\ncorpus at {path!r} exceeds the "
+                f"daemon cap ({max_corpus_bytes} bytes)"
+            )
+    overrides = req.get("config") or {}
+    if not isinstance(overrides, dict):
+        raise ValueError("bad_spec\nconfig must be an object of knobs")
+    unknown = set(overrides) - set(SPEC_CONFIG_KEYS)
+    if unknown:
+        raise ValueError(
+            f"bad_spec\nunknown config keys {sorted(unknown)} "
+            f"(allowed: {SPEC_CONFIG_KEYS})"
+        )
+    try:
+        cfg = EngineConfig(**overrides)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bad_spec\n{e}")
+    try:
+        weight = float(req.get("weight", 1.0))
+    except (TypeError, ValueError):
+        raise ValueError("bad_spec\nweight must be a number")
+    if not 0.0 < weight <= 100.0:
+        raise ValueError(f"bad_spec\nweight must be in (0, 100], got {weight}")
+    tenant = str(req.get("tenant", "default"))[:64] or "default"
+    spec = JobSpec(
+        tenant=tenant,
+        workload=workload,
+        cfg=cfg,
+        weight=weight,
+        invalidate=bool(req.get("invalidate")),
+        no_cache=bool(req.get("no_cache")),
+    )
+    return spec, corpus
+
+
+def pairs_bytes(pairs) -> int:
+    """Approximate retained size of a result pairs list: key bytes plus
+    a small per-pair constant for tuple/int overhead.  An estimate is
+    enough — the byte caps guard against multi-GB retention, not
+    byte-exact accounting."""
+    return sum(len(k) + 16 for k, _ in pairs)
+
+
+@dataclasses.dataclass
+class Job:
+    """Runtime record for one admitted job.
+
+    NOT thread-safe by itself: the daemon mutates jobs only under its own
+    lock (submit/cancel handlers) or from the single dispatcher thread
+    (running -> done/failed), with the state transitions serialized
+    through ``FairScheduler``'s lock.
+    """
+
+    job_id: str
+    spec: JobSpec
+    corpus_digest: str
+    n_lines: int
+    n_blocks: int
+    bucket: int               # shape-bucketed block count (cache.bucket_blocks)
+    state: str = "queued"
+    submitted_s: float = dataclasses.field(default_factory=time.monotonic)
+    started_s: float | None = None
+    finished_s: float | None = None
+    cache: str = "cold"       # "result" | "warm" | "cold" — how it was served
+    result: list | None = None            # [(key bytes, value int), ...]
+    result_bytes: int = 0                 # pairs_bytes(result) at finish
+    error: dict | None = None             # structured_error() dict
+    distinct: int | None = None
+    truncated: bool = False
+    overflow_tokens: int = 0
+    batch_size: int | None = None         # jobs coalesced into its dispatch
+
+    def queue_ms(self) -> float | None:
+        if self.started_s is None:
+            return None
+        return round((self.started_s - self.submitted_s) * 1e3, 3)
+
+    def latency_ms(self) -> float | None:
+        if self.finished_s is None:
+            return None
+        return round((self.finished_s - self.submitted_s) * 1e3, 3)
+
+    def public(self) -> dict:
+        """The ``status`` reply body (no result payload — that is the
+        ``result`` command's job, results can be MBs)."""
+        return {
+            "job_id": self.job_id,
+            "state": self.state,
+            "tenant": self.spec.tenant,
+            "workload": self.spec.workload,
+            "corpus_digest": self.corpus_digest,
+            "n_lines": self.n_lines,
+            "n_blocks": self.n_blocks,
+            "bucket": self.bucket,
+            "cache": self.cache,
+            "queue_ms": self.queue_ms(),
+            "latency_ms": self.latency_ms(),
+            "batch_size": self.batch_size,
+            "error": self.error,
+        }

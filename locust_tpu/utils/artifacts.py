@@ -54,6 +54,20 @@ CONFIG_AB_KINDS = (
     "engine_pallas_ab",
 )
 
+# Bench sub-dict -> evidence-ledger row kind for the guarded non-headline
+# benches (two-sided, same discipline as CONFIG_AB_KINDS): bench.py's
+# sub-dict producer table must match these KEYS exactly (checked with a
+# loud identity error at bench time), and every recorder of one of these
+# KINDS imports the string from here instead of re-spelling it — a
+# sub-dict added without a ledger kind, or a kind recorded that no bench
+# sub-dict reports, fails loudly instead of silently drifting.  The
+# "stream" sub-dict is deliberately absent: its evidence lands in
+# dedicated per-round files (artifacts/stream_*.jsonl), not ledger rows.
+BENCH_SUBDICT_KINDS = {
+    "dataplane": "dataplane_bench",
+    "serve": "serve_bench",
+}
+
 
 def ledger_rows(path: str | None = None) -> list[dict]:
     """Parsed rows of the evidence ledger (malformed lines skipped).

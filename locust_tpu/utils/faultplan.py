@@ -36,6 +36,8 @@ a chaos plan must fail LOUDLY at parse time, not silently inject nothing):
   io.ckpt_write    checkpoint writer between the fully-written tmp
                    snapshot and its atomic rename (io/snapshot.py;
                    docs/FAULTS.md)               ctx: path, generation
+  serve.admit      serve daemon admission path   ctx: tenant, workload
+  serve.dispatch   serve daemon batch dispatch   ctx: jobs
 
 Determinism: rule bookkeeping is pure counting (``after`` skips, ``times``
 caps), and the probabilistic gate + byte mutations derive from
@@ -81,6 +83,16 @@ SITES = {
     # the writer, so it propagates as a structured error); "delay"
     # stalls the writer so the hot loop laps it (latest-wins skips).
     "io.ckpt_write": ("crash", "delay"),
+    # Serve tier (locust_tpu/serve/daemon.py; docs/SERVING.md).
+    # serve.admit fires at the admission boundary: "error" = the client
+    # gets a STRUCTURED rejection (code fault_injected) and may retry;
+    # "delay" = admission contention.  ctx: tenant, workload.
+    "serve.admit": ("error", "delay"),
+    # serve.dispatch fires as a popped batch heads for the engine:
+    # "crash"/"error" = every job in the batch fails with a structured
+    # error (never a silent wrong answer) while the daemon survives;
+    # "delay" = a straggling dispatch.  ctx: jobs (batch size).
+    "serve.dispatch": ("crash", "error", "delay"),
 }
 
 _RULE_KEYS = {"site", "action", "match", "times", "after", "prob", "delay_s"}

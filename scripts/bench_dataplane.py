@@ -55,7 +55,12 @@ def main(argv=None) -> int:
         repeats=args.repeats,
     )
     if not args.no_record:
-        artifacts.record("dataplane_bench", res, force=True)
+        # Kind imported from the two-sided registry, never re-spelled
+        # (artifacts.BENCH_SUBDICT_KINDS — same discipline as
+        # CONFIG_AB_KINDS).
+        artifacts.record(
+            artifacts.BENCH_SUBDICT_KINDS["dataplane"], res, force=True
+        )
     print(json.dumps(res))
     return 0
 

@@ -57,12 +57,21 @@ def main(argv=None) -> int:
         [sys.executable, "-c", _TRACE_ROUNDTRIP], cwd=REPO, env=env,
         timeout=300,
     ).returncode
+
+    # Serve-tier smoke (docs/SERVING.md): a loopback daemon serves a
+    # submit, a result-cache repeat, and a same-bucket warm dispatch,
+    # then shuts down cleanly — the zero-to-serving contract the CLI
+    # (`python -m locust_tpu.serve`) rides.  Same pinned env.
+    serve_rc = subprocess.run(
+        [sys.executable, "-c", _SERVE_SMOKE], cwd=REPO, env=env,
+        timeout=300,
+    ).returncode
     print(
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
-        f"trace round-trip rc={trace_rc}",
+        f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}",
         file=sys.stderr,
     )
-    return rc or proc.returncode or trace_rc
+    return rc or proc.returncode or trace_rc or serve_rc
 
 
 _TRACE_ROUNDTRIP = """
@@ -90,6 +99,34 @@ if missing:
           file=sys.stderr)
     sys.exit(1)
 print(f"[check] trace round-trip ok ({len(names)} span/event names)",
+      file=sys.stderr)
+"""
+
+
+_SERVE_SMOKE = """
+import sys
+from locust_tpu.backend import force_cpu
+force_cpu()
+from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+cfgov = {"block_lines": 8, "line_width": 64, "key_width": 16,
+         "emits_per_line": 8}
+daemon = ServeDaemon(secret=b"check-smoke", cfg=ServeConfig(max_batch=2))
+daemon.serve_in_thread()
+client = ServeClient(daemon.addr, b"check-smoke", timeout=60.0)
+corpus = b"alpha beta gamma\\nbeta gamma delta\\n" * 6
+ack = client.submit(corpus=corpus, config=cfgov)
+res = client.wait(ack["job_id"], timeout=120.0)
+assert dict(res["pairs"]) == {b"alpha": 6, b"beta": 12, b"gamma": 12,
+                              b"delta": 6}, res["pairs"]
+ack2 = client.submit(corpus=corpus, config=cfgov)
+assert ack2["cached"] is True, ack2
+ack3 = client.submit(corpus=corpus, config=cfgov, invalidate=True)
+res3 = client.wait(ack3["job_id"], timeout=120.0)
+assert res3["cache"] == "warm", res3  # same bucket: skipped compilation
+assert dict(res3["pairs"]) == dict(res["pairs"])
+client.shutdown()
+daemon.close()
+print("[check] serve smoke ok (result-cache + warm-executable hits)",
       file=sys.stderr)
 """
 

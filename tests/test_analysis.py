@@ -298,6 +298,7 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/parallel/shuffle.py",
         "locust_tpu/io/snapshot.py",  # hooks io.ckpt_write + io.checkpoint
         "locust_tpu/engine.py",       # hooks via finalize_snapshot call
+        "locust_tpu/serve/daemon.py",  # hooks serve.admit + serve.dispatch
         "tests/test_faults.py",
         "docs/FAULTS.md",
     ):
@@ -608,6 +609,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/distributor/worker.py",
         "locust_tpu/cli.py",
         "locust_tpu/obs/attribution.py",
+        "locust_tpu/serve/daemon.py",  # emits the serve.* spans/metrics
     ):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)

@@ -573,3 +573,21 @@ def test_evidence_readers_match_config_ab_kinds(tmp_path, monkeypatch):
     )
     with pytest.raises(RuntimeError, match="drifted"):
         bench._evidence_tuned_tpu_defaults(defaults)
+
+
+def test_bench_subdict_producers_match_registry(monkeypatch):
+    """The guarded sub-bench producers are two-sided against
+    artifacts.BENCH_SUBDICT_KINDS (same discipline as CONFIG_AB_KINDS):
+    a kind registered without a producer — or vice versa — raises
+    instead of silently dropping a sub-dict from the headline line."""
+    from locust_tpu.utils import artifacts
+
+    subdicts = bench._bench_subdict_producers()
+    assert tuple(subdicts) == tuple(artifacts.BENCH_SUBDICT_KINDS)
+    monkeypatch.setattr(
+        artifacts,
+        "BENCH_SUBDICT_KINDS",
+        dict(artifacts.BENCH_SUBDICT_KINDS, new_sub="new_sub_bench"),
+    )
+    with pytest.raises(RuntimeError, match="drifted"):
+        bench._bench_subdict_producers()

@@ -922,3 +922,145 @@ def test_engine_checkpoint_truncated_clean_restart(tmp_path):
     open(state, "wb").write(data[: len(data) // 3])
     res = eng.run_checkpointed(rows, ckpt, every=2)
     assert dict(res.to_host_pairs()) == {b"aaa": 32, b"bbb": 32, b"ccc": 32}
+
+
+# ---------------------------------------------------------------- serve tier
+#
+# The serving-layer guarantee (docs/SERVING.md): under injected faults at
+# the serve.admit / serve.dispatch sites, a client observes either a
+# CORRECT result or a STRUCTURED error (jobs.ERROR_CODES reason code) —
+# never a silent wrong answer, never a dead daemon.
+
+SERVE_CFG = {
+    "block_lines": 8, "line_width": 64, "key_width": 16,
+    "emits_per_line": 8,
+}
+SERVE_CORPUS = CORPUS * 3
+
+
+def _serve_rig():
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+
+    daemon = ServeDaemon(
+        secret=SECRET,
+        cfg=ServeConfig(max_queue=8, max_batch=2, dispatch_poll_s=0.02),
+    )
+    daemon.serve_in_thread()
+    return daemon, ServeClient(daemon.addr, SECRET, timeout=30.0)
+
+
+def _serve_oracle():
+    return dict(py_wordcount(SERVE_CORPUS.splitlines(),
+                             max_tokens_per_line=8, key_width=16))
+
+
+def test_chaos_serve_admit_error_structured_rejection(tmp_path):
+    """serve.admit error: the submit is REJECTED with the structured
+    fault_injected code; the daemon survives and the next submit runs
+    to an exact result."""
+    from locust_tpu.serve import ServeError
+
+    daemon, client = _serve_rig()
+    try:
+        p = plan([{"site": "serve.admit", "action": "error", "times": 1}])
+        with faultplan.active_plan(p):
+            with pytest.raises(ServeError) as e:
+                client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG)
+            assert e.value.code == "fault_injected"
+            assert p.rules[0].fired == 1
+            # Retry INSIDE the plan: the one-shot rule is spent, the
+            # daemon is healthy, the result is exact.
+            ack = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG)
+            res = client.wait(ack["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+    finally:
+        daemon.close()
+
+
+def test_chaos_serve_dispatch_crash_structured_failure_then_exact(tmp_path):
+    """serve.dispatch crash: every job in the doomed batch FAILS with a
+    structured error (never a silent wrong answer), the daemon's
+    dispatcher survives, and a resubmission produces output identical
+    to the fault-free run."""
+    from locust_tpu.serve import ServeError
+
+    daemon, client = _serve_rig()
+    try:
+        p = plan([{"site": "serve.dispatch", "action": "crash", "times": 1}])
+        with faultplan.active_plan(p):
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            with pytest.raises(ServeError) as e:
+                client.wait(ack["job_id"], timeout=60.0)
+            assert e.value.code == "fault_injected"
+            assert client.status(ack["job_id"])["state"] == "failed"
+            assert p.rules[0].fired == 1
+            ack2 = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            res = client.wait(ack2["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+    finally:
+        daemon.close()
+
+
+def test_chaos_serve_dispatch_delay_straggler_still_exact(tmp_path):
+    """serve.dispatch delay (the straggling-dispatch model): the job is
+    late but the result stays exact and complete."""
+    daemon, client = _serve_rig()
+    try:
+        p = plan([{"site": "serve.dispatch", "action": "delay",
+                   "delay_s": 0.4, "times": 1}])
+        with faultplan.active_plan(p):
+            t0 = time.monotonic()
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            res = client.wait(ack["job_id"], timeout=60.0)
+            elapsed = time.monotonic() - t0
+        assert dict(res["pairs"]) == _serve_oracle()
+        assert p.rules[0].fired == 1
+        assert elapsed >= 0.4  # the straggle actually happened
+    finally:
+        daemon.close()
+
+
+def test_chaos_serve_warm_state_writer_crash_durability_only(tmp_path):
+    """io.ckpt_write crash on the serve warm-state writer: the snapshot
+    is abandoned (previous generation survives), results stay exact, and
+    a restart simply cold-starts the result cache — durability lost for
+    one cadence, correctness untouched."""
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+
+    warm_dir = str(tmp_path / "serve_warm")
+    daemon = ServeDaemon(
+        secret=SECRET,
+        cfg=ServeConfig(max_queue=8, max_batch=2, warm_dir=warm_dir,
+                        warm_every=1, dispatch_poll_s=0.02),
+    )
+    daemon.serve_in_thread()
+    client = ServeClient(daemon.addr, SECRET, timeout=30.0)
+    p = plan([{"site": "io.ckpt_write", "action": "crash"}])  # every publish
+    try:
+        with faultplan.active_plan(p):
+            ack = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG)
+            res = client.wait(ack["job_id"], timeout=60.0)
+            assert dict(res["pairs"]) == _serve_oracle()
+            daemon.close()  # final mark also dies on the injected crash
+        assert p.rules[0].fired >= 1
+    finally:
+        daemon.close()
+    d2 = ServeDaemon(
+        secret=SECRET,
+        cfg=ServeConfig(warm_dir=warm_dir, dispatch_poll_s=0.02),
+    )
+    d2.serve_in_thread()
+    c2 = ServeClient(d2.addr, SECRET, timeout=30.0)
+    try:
+        ack = c2.submit(corpus=SERVE_CORPUS, config=SERVE_CFG)
+        assert ack["cached"] is False  # cold start: no warm file landed
+        res = c2.wait(ack["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+    finally:
+        d2.close()
