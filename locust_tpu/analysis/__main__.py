@@ -1,9 +1,14 @@
-"""CLI: ``python -m locust_tpu.analysis [--json] [--rule R00x] [paths...]``.
+"""CLI: ``python -m locust_tpu.analysis [--json] [--sarif FILE]
+[--changed[=REF]] [--rule R00x] [paths...]``.
 
 Exit codes: 0 = no new findings (baselined findings may remain and are
 reported as such), 1 = new findings, 2 = usage/config error.  The gate
 test (tests/test_analysis.py) runs the same engine in-process; this CLI
-is the dev / CI surface.
+is the dev / CI surface.  ``--changed`` scopes the REPORTED findings to
+lines touched vs a git ref (the fast pre-commit loop; analysis itself is
+always whole-program — the call graph does not shrink with the diff);
+``--sarif`` additionally writes the findings as a SARIF 2.1.0 log for
+CI/PR annotation.
 """
 
 from __future__ import annotations
@@ -14,8 +19,9 @@ import sys
 from locust_tpu.analysis import config as cfg
 from locust_tpu.analysis import run_analysis
 from locust_tpu.analysis.baseline import write_baseline
-from locust_tpu.analysis.core import emit_json
+from locust_tpu.analysis.core import changed_lines, emit_json, scope_to_changed
 from locust_tpu.analysis.registry import all_rules
+from locust_tpu.analysis.sarif import write_sarif
 
 
 def main(argv=None) -> int:
@@ -38,7 +44,19 @@ def main(argv=None) -> int:
                    help="accept all current findings into the baseline")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="report only findings on lines touched vs REF "
+                        "(default HEAD) — the fast pre-commit loop; "
+                        "analysis still runs whole-program")
+    p.add_argument("--sarif", default=None, metavar="FILE",
+                   help="also write the findings as a SARIF 2.1.0 log")
     args = p.parse_args(argv)
+
+    if args.changed is not None and args.write_baseline:
+        print("error: --write-baseline must see the whole tree; drop "
+              "--changed", file=sys.stderr)
+        return 2
 
     if args.list_rules:
         for rid, rcls in sorted(all_rules().items()):
@@ -55,6 +73,21 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.changed is not None:
+        import os
+
+        root = os.path.abspath(args.root or cfg.find_root())
+        try:
+            result = scope_to_changed(result, changed_lines(root, args.changed))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    if args.sarif:
+        catalog = {rid: rcls.title for rid, rcls in all_rules().items()}
+        write_sarif(args.sarif, result, catalog)
+        print(f"sarif: findings written to {args.sarif}", file=sys.stderr)
 
     if args.write_baseline:
         root = args.root or cfg.find_root()

@@ -23,10 +23,31 @@ import hashlib
 import json
 import os
 import re
+import subprocess
 
 # R000 is the engine's own rule id: unparseable files and unauditable
 # (reason-less) suppressions.  It cannot be suppressed.
 ENGINE_RULE = "R000"
+
+# Parse accounting: the one-parse-per-file economy is a pinned contract
+# (tests/test_analysis.py) — every ``ast.parse`` of checked source goes
+# through ``parse_text`` so the regression test can count them.
+_parse_count = 0
+
+
+def parse_text(text: str) -> ast.Module:
+    global _parse_count
+    _parse_count += 1
+    return ast.parse(text)
+
+
+def parse_count() -> int:
+    return _parse_count
+
+
+def reset_parse_count() -> None:
+    global _parse_count
+    _parse_count = 0
 
 _NOQA_RE = re.compile(
     r"#\s*locust:\s*noqa\[([A-Za-z0-9, ]+)\]\s*(.*?)\s*$"
@@ -77,7 +98,7 @@ class SourceFile:
         self.tree: ast.Module | None = None
         self.parse_error: SyntaxError | None = None
         try:
-            self.tree = ast.parse(text)
+            self.tree = parse_text(text)
         except SyntaxError as e:
             self.parse_error = e
         # line number -> (set of rule ids, reason)
@@ -96,10 +117,12 @@ class SourceFile:
 
 class Rule:
     """Base rule.  Subclasses set ``rule_id``/``title`` and override one
-    (or both) of the check hooks.  ``check_file`` runs once per analyzed
+    (or more) of the check hooks.  ``check_file`` runs once per analyzed
     python file; ``check_project`` runs once with the full file set (for
     cross-file registry rules) and may emit findings on non-analyzed
-    paths (e.g. docs/FAULTS.md)."""
+    paths (e.g. docs/FAULTS.md); ``check_program`` runs once with the
+    phase-1 whole-program summaries (summaries.Program) for the
+    interprocedural rules."""
 
     rule_id = "R999"
     title = "unnamed rule"
@@ -109,6 +132,35 @@ class Rule:
 
     def check_project(self, files: list[SourceFile], root: str):
         return ()
+
+    def check_program(self, program):
+        return ()
+
+
+def find_source(files: list[SourceFile], rel: str) -> SourceFile | None:
+    """Already-parsed SourceFile for a repo-relative path — registry
+    rules use this instead of re-reading/re-parsing their anchor modules
+    (the one-parse-per-file economy)."""
+    for f in files:
+        if f.rel == rel:
+            return f
+    return None
+
+
+def parse_registry_module(
+    files: list[SourceFile], root: str, rel: str
+) -> ast.Module | None:
+    """Tree for ``rel``: the phase-1 parse when the file is in the
+    analyzed set (the normal case), a counted one-off parse otherwise
+    (fixture trees that point a rule at an unanalyzed path)."""
+    sf = find_source(files, rel)
+    if sf is not None:
+        return sf.tree
+    try:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return parse_text(f.read())
+    except (OSError, SyntaxError):
+        return None
 
 
 @dataclasses.dataclass
@@ -221,10 +273,24 @@ def run_analysis(
                 )
             )
     parsed = [f for f in files if f.tree is not None]
+    # Phase 1: one pass over the already-parsed trees builds the
+    # whole-program summaries + call graph; phase 2 runs the rules.
+    # Skipped entirely when no selected rule is interprocedural — the
+    # single-rule dev loop (--rule R004) should not pay for summaries
+    # it never reads.
+    program = None
+    if any(
+        type(r).check_program is not Rule.check_program for r in rule_objs
+    ):
+        from locust_tpu.analysis.summaries import build_program
+
+        program = build_program(parsed, root)
     for rule in rule_objs:
         for sf in parsed:
             findings.extend(rule.check_file(sf, root))
         findings.extend(rule.check_project(parsed, root))
+        if program is not None:
+            findings.extend(rule.check_program(program))
 
     # noqa suppression (reason mandatory; R000 is never suppressible).
     kept: list[Finding] = []
@@ -269,6 +335,87 @@ def run_analysis(
         suppressed=suppressed,
         n_files=len(files),
         rules=[r.rule_id for r in rule_objs],
+    )
+
+
+# ------------------------------------------------------------- changed scope
+
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(
+    root: str, ref: str = "HEAD"
+) -> dict[str, set[int] | None]:
+    """{repo-relative path: new-side line numbers touched (None = the
+    whole file)} vs a git ref — the ``--changed`` pre-commit scope.
+    Untracked (not-yet-added) files count whole-file: ``git diff`` never
+    lists them, and a brand-new module silently scoped to nothing would
+    be the exact trap the loud ValueError below exists to prevent.
+    Raises ValueError when git cannot produce the diff (not a repo,
+    unknown ref)."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "diff", "--no-color", "--unified=0",
+             ref, "--"],
+            capture_output=True, text=True, timeout=60,
+        )
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=60,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        raise ValueError(f"--changed needs git: {e}")
+    if out.returncode != 0:
+        raise ValueError(
+            f"git diff {ref!r} failed: {out.stderr.strip() or out.stdout}"
+        )
+    changed: dict[str, set[int] | None] = {}
+    current: set[int] | None = None
+    for line in out.stdout.splitlines():
+        if line.startswith("+++ "):
+            path = line[4:].strip()
+            if path.startswith("b/"):
+                path = path[2:]
+            if path == "/dev/null":
+                current = None
+            else:
+                current = set()
+                changed[path] = current
+        elif current is not None:
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                current.update(range(start, start + max(count, 1)))
+    if untracked.returncode == 0:
+        for path in untracked.stdout.splitlines():
+            if path:
+                changed[path.strip()] = None  # whole file is new
+    return changed
+
+
+def scope_to_changed(
+    result: AnalysisResult, changed: dict[str, set[int] | None]
+) -> AnalysisResult:
+    """Findings restricted to lines touched by the diff.  Full-repo
+    analysis already ran (fingerprints, baseline and suppression are
+    whole-tree facts); this only narrows what is REPORTED/gated."""
+
+    def hit(f: Finding) -> bool:
+        if f.path not in changed:
+            return False
+        lines = changed[f.path]
+        return lines is None or f.line in lines
+
+    kept = [f for f in result.findings if hit(f)]
+    return AnalysisResult(
+        findings=kept,
+        new=[f for f in kept if not f.baselined],
+        suppressed=result.suppressed,
+        n_files=result.n_files,
+        rules=result.rules,
     )
 
 

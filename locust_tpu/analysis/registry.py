@@ -1,4 +1,4 @@
-"""The closed rule registry (R001–R009) — itself anti-drift-checked:
+"""The closed rule registry (R001–R012) — itself anti-drift-checked:
 ``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
 pins that every registered rule has firing + silent fixture coverage."""
 
@@ -13,16 +13,21 @@ from locust_tpu.analysis.rules_hygiene import (
     SubprocessEnvRule,
     TrackedArtifactRule,
 )
+from locust_tpu.analysis.rules_serve import ServeErrorRegistryRule
 from locust_tpu.analysis.rules_telemetry import TelemetryRegistryRule
-from locust_tpu.analysis.rules_threads import ThreadSharedStateRule
+from locust_tpu.analysis.rules_threads import (
+    ThreadLifecycleRule,
+    ThreadSharedStateRule,
+)
 from locust_tpu.analysis.rules_traced import (
+    DonationHygieneRule,
     HostSyncInLoopRule,
     TracedPurityRule,
 )
 
 _RULE_CLASSES = (
-    ThreadSharedStateRule,      # R001
-    TracedPurityRule,           # R002
+    ThreadSharedStateRule,      # R001 (interprocedural since the 2-phase engine)
+    TracedPurityRule,           # R002 (follows traced bodies into callees)
     HostSyncInLoopRule,         # R003
     FaultSiteConsistencyRule,   # R004
     WireConstantDriftRule,      # R005
@@ -30,6 +35,9 @@ _RULE_CLASSES = (
     BenchContractRule,          # R007
     TrackedArtifactRule,        # R008
     TelemetryRegistryRule,      # R009
+    DonationHygieneRule,        # R010
+    ServeErrorRegistryRule,     # R011
+    ThreadLifecycleRule,        # R012
 )
 
 

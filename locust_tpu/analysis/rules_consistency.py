@@ -39,12 +39,14 @@ FAULTS_DOCS_REL = "docs/FAULTS.md"
 _HOOK_NAMES = {"fire", "mangle", "delay", "damage_file", "check_connect"}
 
 
-def _parse_sites(path: str) -> tuple[dict | None, int]:
-    """The SITES dict literal from faultplan.py: {site: line} (+ def line)."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
+def _parse_sites(files, root, rel) -> tuple[dict | None, int]:
+    """The SITES dict literal from faultplan.py: {site: line} (+ def line).
+    Reuses the phase-1 parse when faultplan is in the analyzed set (the
+    one-parse-per-file economy)."""
+    from locust_tpu.analysis.core import parse_registry_module
+
+    tree = parse_registry_module(files, root, rel)
+    if tree is None:
         return None, 0
     for node in tree.body:
         if (
@@ -73,8 +75,7 @@ class FaultSiteConsistencyRule(Rule):
     docs_rel = FAULTS_DOCS_REL
 
     def check_project(self, files, root):
-        fp_path = os.path.join(root, self.faultplan_rel)
-        sites, sites_line = _parse_sites(fp_path)
+        sites, sites_line = _parse_sites(files, root, self.faultplan_rel)
         if sites is None:
             yield Finding(
                 self.rule_id, self.faultplan_rel, 1, 0,
@@ -187,15 +188,14 @@ WIRE_CONSTANTS = {
 _INT_FLOOR = 65536
 
 
-def _defined_constants(root: str) -> dict:
+def _defined_constants(files, root: str) -> dict:
     """{name: (value, definer_rel)} for each wire constant we can read."""
+    from locust_tpu.analysis.core import parse_registry_module
+
     out = {}
     for name, rel in WIRE_CONSTANTS.items():
-        path = os.path.join(root, rel)
-        try:
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read())
-        except (OSError, SyntaxError):
+        tree = parse_registry_module(files, root, rel)
+        if tree is None:
             continue
         for node in tree.body:
             if not isinstance(node, ast.Assign):
@@ -222,7 +222,7 @@ class WireConstantDriftRule(Rule):
     title = "re-spelled wire constant"
 
     def check_project(self, files, root):
-        consts = _defined_constants(root)
+        consts = _defined_constants(files, root)
         by_bytes = {
             v: (n, rel) for n, (v, rel) in consts.items()
             if isinstance(v, bytes)

@@ -24,7 +24,6 @@ never false-positive, which is also why the emission CONVENTION
 from __future__ import annotations
 
 import ast
-import os
 
 from locust_tpu.analysis.core import Finding, Rule, unparse
 
@@ -40,12 +39,13 @@ _EMIT_KINDS = {
 }
 
 
-def _parse_names(path: str) -> tuple[dict | None, int]:
-    """The NAMES dict literal from obs/names.py: {name: (kind, line)}."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
+def _parse_names(files, root, rel) -> tuple[dict | None, int]:
+    """The NAMES dict literal from obs/names.py: {name: (kind, line)}.
+    Reuses the phase-1 parse (one-parse-per-file economy)."""
+    from locust_tpu.analysis.core import parse_registry_module
+
+    tree = parse_registry_module(files, root, rel)
+    if tree is None:
         return None, 0
     for node in tree.body:
         if (
@@ -77,7 +77,7 @@ class TelemetryRegistryRule(Rule):
     names_rel = OBS_NAMES_REL
 
     def check_project(self, files, root):
-        names, _ = _parse_names(os.path.join(root, self.names_rel))
+        names, _ = _parse_names(files, root, self.names_rel)
         if names is None:
             yield Finding(
                 self.rule_id, self.names_rel, 1, 0,

@@ -1,190 +1,158 @@
-"""R001 — thread-shared state written without a lock (lockset heuristic).
+"""R001/R012 — thread-shared state and thread/executor lifecycle.
 
-The incident: the distributor's attempt/fetch/heartbeat threads (PR 1/2)
-were hardened against "abandoned-loser pool-shutdown races" by code
-review, not by tooling.  This rule is the Eraser-style (Savage et al.,
-1997) static shadow of that review: a function that RUNS ON A THREAD
+R001 (interprocedural lockset): a function that RUNS ON A THREAD
 (``threading.Thread(target=...)``, ``executor.submit(fn)``,
 ``executor.map(fn)``) must not write ``self.*`` attributes, ``global``
-names, or ``nonlocal`` closure slots outside a ``with <lock>:`` block.
+names, or own-``nonlocal`` closure slots outside a ``with <lock>:``
+block.  Since the two-phase engine, the Eraser-style (Savage et al.,
+1997) lockset follows CALLS from the entry point across modules through
+the summaries call graph, with the lock context propagated along the
+chain: ``Thread(target=self._loop)`` where ``_loop`` calls ``_once``
+which writes ``self._mark`` unlocked is a finding in ``_once`` — the
+exact shape the serve dispatcher shipped with (PR 7 review rounds).
 
 Heuristics (documented in docs/ANALYSIS.md):
 
-  * entry points are resolved BY NAME within the module (callees of the
-    thread entry are not followed — no interprocedural call graph);
-  * "a lock" is any ``with`` context whose expression mentions
-    lock/mutex/semaphore/cond (``with self._lock:`` etc.);
-  * local variables and attribute writes on non-``self`` locals are NOT
-    flagged (per-shard locals like ``stats.winner`` are thread-private
-    by construction in this codebase; flagging them would bury the
-    signal).
+  * entry points resolve by name (nested defs included); calls resolve
+    through the attribution-only call graph (callgraph.py) and only
+    into top-level functions/methods — a callee nested in the caller is
+    already covered by the caller's whole-subtree summary;
+  * "a lock" is any ``with`` whose context expression mentions
+    lock/mutex/semaphore/cond; a call made INSIDE such a ``with`` marks
+    its whole callee chain as lock-covered ("caller holds the lock"
+    conventions like daemon._corpus_put stay silent);
+  * locals and attribute writes on non-``self`` receivers are not
+    flagged (thread-private by construction in this codebase).
+
+R012 (thread/executor lifecycle): every ``threading.Thread`` in
+``locust_tpu/`` must be daemonized or joined somewhere in its module;
+every bound executor must be ``with``-managed or ``.shutdown(...)``.  A
+non-daemon thread nobody joins outlives crashes and wedges interpreter
+exit — the dispatcher-join and warm-writer-close review incidents
+(serve/daemon.py close(), io/snapshot.py close()) as a machine check.
 """
 
 from __future__ import annotations
 
 import ast
 
-from locust_tpu.analysis.core import Finding, Rule, call_name, unparse
-
-_LOCKISH = ("lock", "mutex", "semaphore", "cond")
-
-
-def _is_lock_ctx(item: ast.withitem) -> bool:
-    src = unparse(item.context_expr).lower()
-    return any(word in src for word in _LOCKISH)
-
-
-def _executor_names(fn: ast.AST) -> set[str]:
-    """Names bound to ThreadPoolExecutor-ish constructors in this scope
-    (``with ThreadPoolExecutor(...) as ex`` / ``pool = ...Executor(...)``)."""
-    names: set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.withitem):
-            ctx, opt = node.context_expr, node.optional_vars
-            if (
-                isinstance(ctx, ast.Call)
-                and "Executor" in call_name(ctx)
-                and isinstance(opt, ast.Name)
-            ):
-                names.add(opt.id)
-        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            if "Executor" in call_name(node.value):
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        names.add(t.id)
-    return names
-
-
-def _entry_refs(tree: ast.Module):
-    """(expr, how) for every function reference handed to a thread."""
-    executors = _executor_names(tree)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        callee = call_name(node)
-        if callee.split(".")[-1] == "Thread":
-            for kw in node.keywords:
-                if kw.arg == "target":
-                    yield kw.value, "threading.Thread target"
-        elif isinstance(node.func, ast.Attribute):
-            owner = node.func.value
-            owner_name = owner.id if isinstance(owner, ast.Name) else None
-            if node.func.attr == "submit" and node.args:
-                yield node.args[0], "executor.submit callable"
-            elif (
-                node.func.attr == "map"
-                and node.args
-                and owner_name in executors
-            ):
-                yield node.args[0], "executor.map callable"
-
-
-def _resolve(ref: ast.AST, by_name: dict):
-    """Thread-entry reference -> function nodes (best-effort, by name)."""
-    if isinstance(ref, ast.Lambda):
-        return [ref]
-    if isinstance(ref, ast.Name):
-        return by_name.get(ref.id, [])
-    if isinstance(ref, ast.Attribute):  # self.method / obj.method
-        return by_name.get(ref.attr, [])
-    return []
-
-
-class _WriteScanner:
-    """Walk a thread-entry body tracking lock context; collect unlocked
-    writes to self.*/global/nonlocal state."""
-
-    def __init__(self, shared_names: set[str]):
-        self.shared = shared_names  # global/nonlocal-declared in this fn
-        self.hits: list[tuple[ast.AST, str]] = []
-
-    def scan(self, node: ast.AST, locked: bool) -> None:
-        if isinstance(node, ast.With):
-            inner = locked or any(_is_lock_ctx(i) for i in node.items)
-            for child in ast.iter_child_nodes(node):
-                self.scan(child, inner)
-            return
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = (
-                node.targets
-                if isinstance(node, ast.Assign)
-                else [node.target]
-            )
-            if not locked:
-                for t in targets:
-                    desc = self._shared_target(t)
-                    if desc:
-                        self.hits.append((node, desc))
-        for child in ast.iter_child_nodes(node):
-            self.scan(child, locked)
-
-    def _shared_target(self, t: ast.AST) -> str | None:
-        root = t
-        while isinstance(root, ast.Subscript):
-            root = root.value
-        if isinstance(root, ast.Attribute):
-            base = root.value
-            if isinstance(base, ast.Name) and base.id == "self":
-                return f"self.{root.attr}"
-        if isinstance(root, ast.Name) and root.id in self.shared:
-            return root.id
-        return None
-
-
-def _declared_shared(fn: ast.AST) -> set[str]:
-    """Names this entry function shares across threads: ``global``
-    anywhere in its subtree, but ``nonlocal`` only when DECLARED BY the
-    entry function itself — a nested def's nonlocal refers to the entry
-    function's own locals, which are private to its thread."""
-    names: set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Global):
-            names.update(node.names)
-
-    def own_statements(node):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                continue
-            yield child
-            yield from own_statements(child)
-
-    for node in own_statements(fn):
-        if isinstance(node, ast.Nonlocal):
-            names.update(node.names)
-    return names
+from locust_tpu.analysis.core import Finding, Rule, unparse
 
 
 class ThreadSharedStateRule(Rule):
     rule_id = "R001"
     title = "thread-shared state written without a lock"
 
-    def check_file(self, f, root):
-        tree = f.tree
-        by_name: dict[str, list] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                by_name.setdefault(node.name, []).append(node)
-        seen: set[int] = set()
-        for ref, how in _entry_refs(tree):
-            for fn in _resolve(ref, by_name):
-                if id(fn) in seen:
+    _MAX_DEPTH = 8
+
+    def check_program(self, program):
+        emitted: set[tuple] = set()
+        for mod in program.modules.values():
+            for ref, how in mod.thread_entries:
+                for fn in self._resolve_entry(program, mod, ref):
+                    yield from self._visit(
+                        program, fn, how, entry=fn.name, chain=(fn.name,),
+                        locked=False, depth=0, visited={}, emitted=emitted,
+                    )
+
+    def _resolve_entry(self, program, mod, ref):
+        if isinstance(ref, ast.Lambda):
+            return [mod.lambda_summary(ref)]
+        if isinstance(ref, ast.Name):
+            return program.graph.resolve(mod, ref.id, include_nested=True)
+        if isinstance(ref, ast.Attribute):
+            return program.graph.resolve(
+                mod, unparse(ref), include_nested=True
+            )
+        return []
+
+    def _visit(self, program, fn, how, entry, chain, locked, depth,
+               visited, emitted):
+        # Revisit only when arriving with a WEAKER lock context than any
+        # prior visit (unlocked findings dominate).  A depth-truncated
+        # visit is NOT recorded: it never explored its callees, and
+        # marking it would blind a later shallower path (the emitted-set
+        # dedups any re-reported writes; depth still bounds recursion).
+        prev = visited.get(id(fn.node))
+        if prev is not None and (prev is False or locked):
+            return
+        if depth < self._MAX_DEPTH:
+            visited[id(fn.node)] = locked
+        for w in fn.writes:
+            if locked or w.locked:
+                continue
+            key = (fn.rel, w.line, w.desc)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            if len(chain) == 1:
+                detail = f"'{fn.name}' runs on a thread ({how})"
+            else:
+                detail = (
+                    f"'{fn.name}' is reached from thread entry "
+                    f"'{entry}' ({how}) via {' -> '.join(chain)}"
+                )
+            yield Finding(
+                self.rule_id, fn.rel, w.line, w.col,
+                f"{detail} and writes shared state {w.desc} with no "
+                "enclosing 'with <lock>:' on the call path — a data race "
+                "heuristic; guard it or noqa with the synchronization "
+                "argument",
+            )
+        if depth >= self._MAX_DEPTH:
+            return
+        for c in fn.calls:
+            targets = program.graph.resolve(fn.module, c.callee)
+            for callee in targets:
+                if callee.node is fn.node:
                     continue
-                seen.add(id(fn))
-                shared = _declared_shared(fn)
-                scanner = _WriteScanner(shared)
-                body = fn.body if hasattr(fn, "body") else [fn]
-                for stmt in body if isinstance(body, list) else [body]:
-                    scanner.scan(stmt, locked=False)
-                name = getattr(fn, "name", "<lambda>")
-                for node, desc in scanner.hits:
+                yield from self._visit(
+                    program, callee, how, entry,
+                    chain + (callee.name,), locked or c.locked,
+                    depth + 1, visited, emitted,
+                )
+
+
+class ThreadLifecycleRule(Rule):
+    rule_id = "R012"
+    title = "thread/executor without a daemon flag, join, or shutdown"
+
+    def check_program(self, program):
+        for mod in program.modules.values():
+            if not mod.rel.startswith("locust_tpu/"):
+                continue  # tests/scripts own their process lifetime
+            for s in mod.spawns:
+                if s.kind == "thread":
+                    if s.daemon:
+                        continue
+                    if s.bound is not None and s.bound in mod.joined:
+                        continue
+                    if s.bound is None and not s.chained_start:
+                        continue  # passed/returned: can't attribute
+                    where = (
+                        f"bound to {s.bound!r}" if s.bound
+                        else "started inline"
+                    )
                     yield Finding(
-                        self.rule_id,
-                        f.rel,
-                        node.lineno,
-                        node.col_offset,
-                        f"'{name}' runs on a thread ({how}) and writes "
-                        f"shared state {desc} with no enclosing "
-                        "'with <lock>:' — a data race heuristic; guard it "
-                        "or noqa with the synchronization argument",
+                        self.rule_id, mod.rel, s.line, s.col,
+                        f"non-daemon Thread {where} is never joined in "
+                        "this module — it outlives crashes and wedges "
+                        "interpreter exit; pass daemon=True or join it on "
+                        "a reachable close path (the serve dispatcher-join "
+                        "/ warm-writer-close incidents)",
+                    )
+                else:  # executor
+                    if s.in_with:
+                        continue
+                    if s.bound is not None and s.bound in mod.shutdown:
+                        continue
+                    if s.bound is None:
+                        continue  # unattributable construction
+                    yield Finding(
+                        self.rule_id, mod.rel, s.line, s.col,
+                        f"executor bound to {s.bound!r} has no "
+                        "``with``-scope and no .shutdown(...) call in "
+                        "this module — worker threads leak past the work "
+                        "they were built for; scope it or shut it down on "
+                        "a reachable close path",
                     )

@@ -1,0 +1,71 @@
+"""SARIF 2.1.0 emission — findings as a standard static-analysis log.
+
+One run, one tool (``locust-analysis``), one result per finding; the
+content-addressed fingerprint (core._fingerprint) rides in
+``partialFingerprints`` so SARIF consumers dedupe across line drift
+exactly like the native baseline does, and ``baselineState`` carries the
+new/baselined split.  The shape here is pinned by
+tests/test_analysis.py::test_sarif_schema_shape — CI/PR annotators
+consume this file without any new infrastructure (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def sarif_report(result, rule_catalog: dict[str, str]) -> dict:
+    """``AnalysisResult`` + {rule id: title} -> a SARIF log dict."""
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule_id,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+            "partialFingerprints": {
+                "locustFingerprint/v1": f.fingerprint,
+            },
+            "baselineState": "unchanged" if f.baselined else "new",
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "locust-analysis",
+                    "informationUri": "docs/ANALYSIS.md",
+                    "rules": [
+                        {
+                            "id": rid,
+                            "shortDescription": {"text": title},
+                        }
+                        for rid, title in sorted(rule_catalog.items())
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, result, rule_catalog: dict[str, str]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(sarif_report(result, rule_catalog), f, indent=2,
+                  sort_keys=True)
+        f.write("\n")

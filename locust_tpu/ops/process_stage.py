@@ -324,7 +324,7 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
         # engine measured THIS stock formulation, not the Pallas kernel —
         # a silent substitution would let a future A/B conclude the
         # kernel gives no mesh speedup when it never ran.
-        global _warned_bitonic_fallback
+        global _warned_bitonic_fallback  # locust: noqa[R002] deliberate warn-once AT TRACE TIME: the substitution notice must fire exactly when tracing picks the stock fallback
         if not _warned_bitonic_fallback:
             _warned_bitonic_fallback = True
             logger.warning(
@@ -354,7 +354,7 @@ def _bitonic_sort(batch: KVBatch) -> KVBatch:
         # engine dispatch never takes interpret mode on legacy jax; the
         # kernel's interpret traceability stays covered by the direct
         # small tests (tests/test_bitonic.py, test_distributed.py).
-        global _warned_bitonic_interpret
+        global _warned_bitonic_interpret  # locust: noqa[R002] deliberate warn-once AT TRACE TIME: the legacy-jax interpret-skip notice must fire exactly when tracing takes this branch
         if not _warned_bitonic_interpret:
             _warned_bitonic_interpret = True
             logger.warning(

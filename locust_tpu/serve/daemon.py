@@ -753,17 +753,23 @@ class ServeDaemon:
                 "dispatch_failed", f"{type(e).__name__}: {e}"
             ))
             return
-        if (self.warm is not None
-                and completed - self._warm_marked >= self.cfg.warm_every):
+        if self.warm is not None:
             # Latest-wins background generation: the dispatcher never
             # blocks on disk (io/snapshot.py).  Distance-based cadence,
             # not modulo: ``completed`` advances by batch size here and
             # by result-cache hits on handler threads, so the dispatcher
             # may never OBSERVE a multiple of warm_every — a modulo
             # check could skip marks forever and silently demote the
-            # cadence to "clean shutdown only".
-            self._warm_marked = completed
-            self.warm.mark(completed)
+            # cadence to "clean shutdown only".  The cursor read+write
+            # holds the lock (close() snapshots the generation counter
+            # under it); the mark itself stays outside — it only enqueues
+            # on the async writer.
+            with self._lock:
+                due = completed - self._warm_marked >= self.cfg.warm_every
+                if due:
+                    self._warm_marked = completed
+            if due:
+                self.warm.mark(completed)
 
     def _fail_batch(self, jobs: list[Job], error: dict) -> None:
         now = time.monotonic()

@@ -3,10 +3,11 @@
 
     env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
 
-Runs (1) the invariant checker over the configured paths (exit 1 on new
-findings — docs/ANALYSIS.md), (2) tests/test_analysis.py, which includes
-the repo-wide gate test, and (3) a small traced engine run whose
-exported timeline is validated against locust_tpu/obs/trace.schema.json (the obs
+Runs (1) the two-phase invariant checker (R001-R012) over the configured
+paths (exit 1 on new findings — docs/ANALYSIS.md) including a SARIF
+emission round-trip, (2) tests/test_analysis.py, which includes the
+repo-wide gate test, and (3) a small traced engine run whose exported
+timeline is validated against locust_tpu/obs/trace.schema.json (the obs
 contract, docs/OBSERVABILITY.md) — in a subprocess with a pinned env, so
 this process stays jax-free.  ``--fast`` skips (2) and (3).
 Exit code is non-zero if any part fails.
@@ -38,6 +39,24 @@ def main(argv=None) -> int:
         file=sys.stderr,
     )
     rc = 1 if result.new else 0
+
+    # SARIF emission round-trip: the CI-annotation surface must stay a
+    # loadable 2.1.0 log whatever the findings are (docs/ANALYSIS.md).
+    import json
+    import tempfile
+
+    from locust_tpu.analysis.registry import all_rules
+    from locust_tpu.analysis.sarif import write_sarif
+
+    with tempfile.TemporaryDirectory() as td:
+        sarif_path = os.path.join(td, "check.sarif")
+        write_sarif(sarif_path, result,
+                    {rid: r.title for rid, r in all_rules().items()})
+        with open(sarif_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("version") != "2.1.0":
+            print("[check] sarif round-trip: bad version", file=sys.stderr)
+            rc = rc or 1
     if fast:
         return rc
 

@@ -628,6 +628,420 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
     assert "never emitted" in res.new[0].message
 
 
+# ------------------------------------------- R001/R002 interprocedural
+
+_R001_ENTRY = """
+    import threading
+    from locust_tpu.state import bump
+
+    class Srv:
+        def start(self):
+            threading.Thread(target=self.worker, daemon=True).start()
+
+        def worker(self):
+            bump()
+"""
+_R001_HELPER = """
+    total = 0
+
+    def bump():
+        global total
+        total += 1
+"""
+
+
+def test_r001_cross_module_race_the_per_module_engine_missed(tmp_path):
+    """The acceptance fixture: the thread entry lives in a.py, the
+    unlocked global write in state.py.  Either file ALONE is silent —
+    which is exactly what the old single-pass per-module engine saw —
+    but the whole program is a finding, attributed to the write."""
+    _write(tmp_path, "locust_tpu/a.py", _R001_ENTRY)
+    _write(tmp_path, "locust_tpu/state.py", _R001_HELPER)
+    # Per-module views (the old engine's blind spot): both silent.
+    assert not _run(tmp_path, ["R001"], ["locust_tpu/a.py"]).new
+    assert not _run(tmp_path, ["R001"], ["locust_tpu/state.py"]).new
+    # Whole program: the race is visible, flagged AT the write.
+    res = _run(tmp_path, ["R001"], ["locust_tpu"])
+    assert len(res.new) == 1
+    f = res.new[0]
+    assert f.path == "locust_tpu/state.py"
+    assert "total" in f.message and "worker" in f.message
+
+
+def test_r001_same_module_call_chain_fires(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Srv:
+            def start(self):
+                threading.Thread(target=self.loop, daemon=True).start()
+
+            def loop(self):
+                self.step()
+
+            def step(self):
+                self.count = 1
+    """)
+    res = _run(tmp_path, ["R001"], ["mod.py"])
+    assert len(res.new) == 1
+    assert "self.count" in res.new[0].message
+    assert "loop -> step" in res.new[0].message
+
+
+def test_r001_silent_when_lock_held_across_the_call(tmp_path):
+    # The "caller holds self._lock" convention (daemon._corpus_put):
+    # a call made inside `with <lock>:` covers the whole callee chain.
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self.loop, daemon=True).start()
+
+            def loop(self):
+                with self._lock:
+                    self.step()
+
+            def step(self):
+                self.count = 1
+    """)
+    assert not _run(tmp_path, ["R001"], ["mod.py"]).new
+
+
+def test_r002_cross_module_impurity_in_traced_callee(tmp_path):
+    _write(tmp_path, "locust_tpu/kernels.py", """
+        import jax
+        from locust_tpu.helpers import stamp
+
+        def step(x):
+            return stamp(x)
+
+        step_j = jax.jit(step)
+    """)
+    _write(tmp_path, "locust_tpu/helpers.py", """
+        import time
+
+        def stamp(x):
+            return x * time.time()
+    """)
+    # Alone, neither module shows the bug (the old engine's limit)...
+    assert not _run(tmp_path, ["R002"], ["locust_tpu/kernels.py"]).new
+    assert not _run(tmp_path, ["R002"], ["locust_tpu/helpers.py"]).new
+    # ...together the traced body is followed into its callee.
+    res = _run(tmp_path, ["R002"], ["locust_tpu"])
+    assert len(res.new) == 1
+    f = res.new[0]
+    assert f.path == "locust_tpu/helpers.py"
+    assert "time.time" in f.message and "step" in f.message
+
+
+def test_r002_silent_on_pure_cross_module_callee(tmp_path):
+    _write(tmp_path, "locust_tpu/kernels.py", """
+        import jax
+        from locust_tpu.helpers import double
+
+        def step(x):
+            return double(x)
+
+        step_j = jax.jit(step)
+    """)
+    _write(tmp_path, "locust_tpu/helpers.py", """
+        def double(x):
+            return x * 2
+    """)
+    assert not _run(tmp_path, ["R002"], ["locust_tpu"]).new
+
+
+# ------------------------------------------------------------------- R010
+
+_R010_PRELUDE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def fold(acc, blk):
+        return acc
+
+    fold_j = jax.jit(fold, donate_argnums=(0,))
+"""
+
+
+def test_r010_fires_on_donated_numpy_alias(tmp_path):
+    _write(tmp_path, "locust_tpu/eng.py", _R010_PRELUDE + """
+    def run(z, blk):
+        acc = jnp.asarray(z["table"])  # zero-copy view of host memory
+        acc = fold_j(acc, blk)
+        return acc
+    """)
+    res = _run(tmp_path, ["R010"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "alias" in res.new[0].message
+    assert "copy=True" in res.new[0].message
+
+
+def test_r010_fires_on_alias_through_a_helper_return(tmp_path):
+    # The PR 5 incident shape: the alias is BORN in a loader helper and
+    # donated by the caller — one call-graph hop apart.
+    _write(tmp_path, "locust_tpu/eng.py", _R010_PRELUDE + """
+    class Table:
+        pass
+
+    def load(z, acc):
+        if z is not None:
+            acc = Table(jnp.asarray(z["table"]))
+        return 0, acc
+
+    def run(z, blk):
+        start, acc = load(z, None)
+        acc = fold_j(acc, blk)
+        return acc
+    """)
+    res = _run(tmp_path, ["R010"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "alias" in res.new[0].message
+
+
+def test_r010_fires_on_read_after_donate(tmp_path):
+    _write(tmp_path, "locust_tpu/eng.py", _R010_PRELUDE + """
+    def run(acc, blk):
+        out = fold_j(acc, blk)
+        return acc.sum() + out
+    """)
+    res = _run(tmp_path, ["R010"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "read after being donated" in res.new[0].message
+
+
+def test_r010_silent_on_copied_restore_and_rebinding_loop(tmp_path):
+    # The sanctioned shapes: jnp.array(..., copy=True) owns the memory,
+    # and the fold loop rebinds the accumulator every donation.
+    _write(tmp_path, "locust_tpu/eng.py", _R010_PRELUDE + """
+    def run(z, blocks):
+        acc = jnp.array(z["table"], copy=True)
+        for blk in blocks:
+            acc = fold_j(acc, blk)
+        jax.block_until_ready(acc)
+        return acc
+    """)
+    assert not _run(tmp_path, ["R010"], ["locust_tpu"]).new
+
+
+def test_r010_mutating_real_engine_restore_fails_the_gate(tmp_path):
+    """The acceptance demo on the REAL donation site: engine._load_state
+    materializes the restored table with jnp.array(..., copy=True)
+    exactly because the first resumed fold donates it (the PR 5 heap
+    corruption).  Reverting that fix to jnp.asarray must be FLAGGED —
+    the old engine (no R010, no cross-function alias tracking) passed
+    this exact bug into the tree."""
+    dst = tmp_path / "locust_tpu/engine.py"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, "locust_tpu/engine.py"), dst)
+    assert not _run(tmp_path, ["R010"], ["locust_tpu"]).new  # faithful: green
+
+    text = dst.read_text()
+    assert 'jnp.array(z["key_lanes"], copy=True)' in text
+    dst.write_text(text.replace(
+        'jnp.array(z["key_lanes"], copy=True)',
+        'jnp.asarray(z["key_lanes"])',
+    ))
+    res = _run(tmp_path, ["R010"], ["locust_tpu"])
+    assert res.new, "reverted copy=True fix must be flagged"
+    assert all(f.path == "locust_tpu/engine.py" for f in res.new)
+    assert any("alias" in f.message for f in res.new)
+
+
+# ------------------------------------------------------------------- R011
+
+_FIXTURE_JOBS = """
+    ERROR_CODES = (
+        "queue_full",
+        "bad_spec",
+    )
+
+    def structured_error(code, message):
+        if code not in ERROR_CODES:
+            raise ValueError(f"unknown serve error code {code!r}")
+        return {"status": "error", "code": code, "error": message}
+
+    def parse_spec(req):
+        if "corpus" not in req:
+            raise ValueError("bad_spec\\nsubmit needs a corpus")
+        return req
+"""
+
+
+def _r011_tree(tmp_path, daemon=None, jobs=_FIXTURE_JOBS,
+               docs_text=None, tests_text=None):
+    _write(tmp_path, "locust_tpu/serve/jobs.py", jobs)
+    _write(tmp_path, "locust_tpu/serve/daemon.py", daemon if daemon is not None else """
+        from locust_tpu.serve.jobs import structured_error
+
+        def handle(req):
+            if req is None:
+                return structured_error("queue_full", "full")
+            return {"status": "ok"}
+    """)
+    _write(tmp_path, "tests/test_serve.py",
+           tests_text if tests_text is not None
+           else '# exercises "queue_full" and "bad_spec"\n')
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "SERVING.md").write_text(
+        docs_text if docs_text is not None
+        else "| `queue_full` | ... |\n| `bad_spec` | ... |\n"
+    )
+
+
+def test_r011_silent_when_registry_emitters_docs_tests_agree(tmp_path):
+    _r011_tree(tmp_path)
+    assert not _run(tmp_path, ["R011"], ["locust_tpu", "tests"]).new
+
+
+def test_r011_fires_on_unregistered_code_at_emission_site(tmp_path):
+    _r011_tree(tmp_path, daemon="""
+        from locust_tpu.serve.jobs import structured_error
+
+        def handle(req):
+            return structured_error("queue_fulll", "typo'd")
+    """)
+    res = _run(tmp_path, ["R011"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "queue_fulll" in msgs and "not in jobs.ERROR_CODES" in msgs
+    # ...and the now-unemitted registered code fires the other side.
+    assert "never emitted" in msgs
+
+
+def test_r011_fires_on_valueerror_first_line_convention(tmp_path):
+    # parse_spec's ValueError("code\\n...") shape is an emission site too.
+    _r011_tree(tmp_path, jobs=_FIXTURE_JOBS.replace(
+        '"bad_spec\\nsubmit needs a corpus"',
+        '"bad_spce\\nsubmit needs a corpus"',
+    ))
+    res = _run(tmp_path, ["R011"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "bad_spce" in msgs and "not in jobs.ERROR_CODES" in msgs
+
+
+def test_r011_fires_on_undocumented_and_untested_code(tmp_path):
+    _r011_tree(tmp_path, docs_text="| `queue_full` |\n",
+               tests_text='# only "queue_full" here\n')
+    res = _run(tmp_path, ["R011"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "undocumented" in msgs and "never exercised" in msgs
+    assert all("bad_spec" in f.message for f in res.new)
+
+
+def test_r011_mutating_real_error_codes_fails_the_gate(tmp_path):
+    """R004-style acceptance demo on the REAL serve tier: copy the
+    registry + every emitting module + docs + suites, register one
+    phantom code — the gate must fail with exactly the unemitted/
+    undocumented/untested findings for it (the shutting_down /
+    result_too_large / unknown_job review incidents, machine-checked)."""
+    for rel in (
+        "locust_tpu/serve/jobs.py",
+        "locust_tpu/serve/daemon.py",
+        "locust_tpu/serve/scheduler.py",
+        "locust_tpu/serve/cache.py",
+        "locust_tpu/serve/batch.py",
+        "locust_tpu/serve/client.py",
+        "tests/test_serve.py",
+        "tests/test_faults.py",
+        "docs/SERVING.md",
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    paths = ["locust_tpu", "tests"]
+    assert not _run(tmp_path, ["R011"], paths).new  # faithful copy: green
+
+    jp = tmp_path / "locust_tpu/serve/jobs.py"
+    mutated = jp.read_text().replace(
+        "ERROR_CODES = (", 'ERROR_CODES = (\n    "phantom_code",', 1
+    )
+    assert "phantom_code" in mutated
+    jp.write_text(mutated)
+    res = _run(tmp_path, ["R011"], paths)
+    assert len(res.new) == 3  # unemitted + undocumented + untested
+    assert all("phantom_code" in f.message for f in res.new)
+
+
+# ------------------------------------------------------------------- R012
+
+
+def test_r012_fires_on_unjoined_thread_and_unmanaged_executor(tmp_path):
+    _write(tmp_path, "locust_tpu/svc.py", """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self.run)
+                self._t.start()
+                self._pool = ThreadPoolExecutor(max_workers=2)
+
+            def run(self):
+                pass
+    """)
+    res = _run(tmp_path, ["R012"], ["locust_tpu"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert len(res.new) == 2
+    assert "never joined" in msgs and "no .shutdown" in msgs
+
+
+def test_r012_fires_on_inline_started_non_daemon_thread(tmp_path):
+    _write(tmp_path, "locust_tpu/svc.py", """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn).start()
+    """)
+    res = _run(tmp_path, ["R012"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "started inline" in res.new[0].message
+
+
+def test_r012_silent_on_daemon_join_with_and_shutdown(tmp_path):
+    _write(tmp_path, "locust_tpu/svc.py", """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self.run, daemon=True)
+                self._t.start()
+                self._pool = ThreadPoolExecutor(max_workers=2)
+
+            def close(self):
+                self._pool.shutdown(wait=False)
+                self._t.join(timeout=5.0)
+
+            def run(self):
+                pass
+
+        def work(items):
+            with ThreadPoolExecutor() as ex:
+                return list(ex.map(str, items))
+
+        def spawn_joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """)
+    assert not _run(tmp_path, ["R012"], ["locust_tpu"]).new
+
+
+def test_r012_ignores_tests_and_scripts(tmp_path):
+    _write(tmp_path, "scripts/tool.py", """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn).start()
+    """)
+    assert not _run(tmp_path, ["R012"], ["scripts"]).new
+
+
 # --------------------------------------------------------- noqa + baseline
 
 
@@ -775,7 +1189,7 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
-        "R009",
+        "R009", "R010", "R011", "R012",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])
@@ -802,6 +1216,162 @@ def test_cli_rule_filter_and_unknown_rule(tmp_path):
     )
     assert proc.returncode == 2
     assert "unknown rule" in proc.stderr
+
+
+# ------------------------------------------------- --changed and SARIF
+
+_R003_HOT = """
+    import jax
+
+    def drain(blocks):
+        for b in blocks:
+            jax.block_until_ready(b)
+"""
+
+
+def _git(root, *args):
+    proc = subprocess.run(
+        ["git", "-C", str(root), "-c", "user.name=t",
+         "-c", "user.email=t@t", *args],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_changed_scope_drops_preexisting_findings(tmp_path):
+    from locust_tpu.analysis.core import changed_lines, scope_to_changed
+
+    # A committed pre-existing violation + a fresh uncommitted one.
+    _write(tmp_path, "locust_tpu/old.py", _R003_HOT)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    _write(tmp_path, "locust_tpu/hot.py", _R003_HOT)
+    _git(tmp_path, "add", "-A")  # --changed diffs vs HEAD: staged counts
+
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert len(res.new) == 2  # full-repo behavior unchanged
+    scoped = scope_to_changed(res, changed_lines(str(tmp_path), "HEAD"))
+    assert [f.path for f in scoped.new] == ["locust_tpu/hot.py"]
+
+
+def test_changed_scope_includes_untracked_files(tmp_path):
+    # git diff never lists a not-yet-added file; --changed must still
+    # see it whole-file, or a brand-new module is silently unscoped.
+    from locust_tpu.analysis.core import changed_lines, scope_to_changed
+
+    _git(tmp_path, "init", "-q")
+    _write(tmp_path, "locust_tpu/seed.py", "X = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    _write(tmp_path, "locust_tpu/fresh.py", _R003_HOT)  # untracked
+
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    scoped = scope_to_changed(res, changed_lines(str(tmp_path), "HEAD"))
+    assert [f.path for f in scoped.new] == ["locust_tpu/fresh.py"]
+
+
+def test_changed_lines_unknown_ref_is_loud(tmp_path):
+    from locust_tpu.analysis.core import changed_lines
+
+    _git(tmp_path, "init", "-q")
+    with pytest.raises(ValueError):
+        changed_lines(str(tmp_path), "no-such-ref")
+
+
+def test_cli_changed_scopes_exit_code(tmp_path):
+    _write(tmp_path, "locust_tpu/old.py", _R003_HOT)
+    _write(tmp_path, "pyproject.toml", """
+        [tool.locust-analysis]
+        paths = ["locust_tpu"]
+    """)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    # Full run fails on the committed violation; --changed (clean tree,
+    # empty diff) scopes it away — the fast pre-commit loop.
+    full = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--root",
+         str(tmp_path)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert full.returncode == 1
+    scoped = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--root",
+         str(tmp_path), "--changed"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert scoped.returncode == 0, scoped.stdout + scoped.stderr
+
+
+def test_sarif_schema_shape(tmp_path):
+    """Pin the SARIF 2.1.0 surface CI annotators consume."""
+    from locust_tpu.analysis.sarif import sarif_report
+
+    _write(tmp_path, "locust_tpu/hot.py", _R003_HOT)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    assert len(res.new) == 1
+    doc = sarif_report(res, {"R003": "host sync inside a hot loop"})
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "locust-analysis"
+    assert [r["id"] for r in driver["rules"]] == ["R003"]
+    assert driver["rules"][0]["shortDescription"]["text"]
+    result = run["results"][0]
+    assert result["ruleId"] == "R003"
+    assert result["level"] == "error"
+    assert result["message"]["text"]
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "locust_tpu/hot.py"
+    assert loc["region"]["startLine"] == res.new[0].line
+    assert loc["region"]["startColumn"] == res.new[0].col + 1
+    assert (result["partialFingerprints"]["locustFingerprint/v1"]
+            == res.new[0].fingerprint)
+    assert result["baselineState"] == "new"
+
+
+def test_cli_sarif_writes_parseable_log(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    out = tmp_path / "findings.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--rule", "R008",
+         "--sarif", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "locust-analysis"
+
+
+# ----------------------------------------------------- two-phase engine
+
+
+def test_full_repo_run_is_fast_and_parses_each_file_once():
+    """The analyzer self-perf pin: the two-phase engine must stay cheap
+    enough to live inside tier-1 (< 10 s on the CPU container) and keep
+    the one-parse-per-file economy — phase 2 runs over summaries, and
+    the registry rules reuse phase-1 trees instead of re-reading their
+    anchor modules."""
+    import time as _time
+
+    from locust_tpu.analysis import core as acore
+
+    acore.reset_parse_count()
+    t0 = _time.perf_counter()
+    res = run_analysis(root=REPO)
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 10.0, f"full-repo analysis took {elapsed:.1f}s"
+    assert acore.parse_count() == res.n_files, (
+        f"{acore.parse_count()} parses for {res.n_files} files — "
+        "a rule is re-parsing instead of reusing phase-1 trees"
+    )
 
 
 # ------------------------------------------------------------ THE GATE
