@@ -748,6 +748,155 @@ def _serve_stats() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _recovery_stats() -> dict:
+    """Durability-tier summary for the one-line JSON (docs/SERVING.md
+    "Durability guarantee"): journal append overhead per admit, and the
+    restart-to-first-result MTTR of a crash-recovery replay.
+
+    Two measurements against in-process loopback daemons:
+
+      * **append overhead** — the same job stream admitted twice, once
+        with the write-ahead journal and once without; the journal's own
+        per-append accounting (``JobJournal.stats``) divided by the
+        journaled daemon's mean admit (submit ack) latency.  Acceptance:
+        <= 5% of admit latency.
+      * **MTTR** — jobs acked but never dispatched (the scheduler is
+        paused = the mid-batch window), the daemon abandoned WITHOUT its
+        graceful close (the in-process kill -9), then a fresh daemon on
+        the same journal: restart-to-first-result measures daemon
+        construction (replay included) until the first replayed job
+        answers, restart-to-all until the last does.
+
+    Guarded like the siblings: a failure never costs the headline line;
+    ``LOCUST_BENCH_RECOVERY=0`` skips.  Completed runs land a
+    ``recovery_bench`` evidence row (artifacts.BENCH_SUBDICT_KINDS).
+    """
+    if os.environ.get("LOCUST_BENCH_RECOVERY", "1") == "0":
+        return {"skipped": True}
+    try:
+        import shutil
+        import tempfile
+
+        from locust_tpu.io.corpus import synthetic_corpus
+        from locust_tpu.serve.client import ServeClient
+        from locust_tpu.serve.daemon import ServeConfig, ServeDaemon
+
+        cfg = {"block_lines": 256, "key_width": 16, "emits_per_line": 12}
+        # Overhead phase: REALISTIC (MB-scale) inline corpora — admit
+        # latency there is dominated by the transfer + b64 + sha the
+        # submit already pays, which is what the O(1) WAL record rides
+        # on; 10 KB toy corpora would make the constant fsync look huge
+        # against an artificially cheap admit.  MTTR phase: small jobs,
+        # so the replay recompute measures restart machinery, not fold
+        # throughput.
+        big = [
+            b"\n".join(synthetic_corpus(
+                1 << 20, n_vocab=4000, seed=s, words_per_line=8
+            )) + b"\n"
+            for s in range(4)
+        ]
+        small = [
+            b"\n".join(synthetic_corpus(
+                200 * 64, n_vocab=2000, seed=100 + s, words_per_line=6
+            )[:200]) + b"\n"
+            for s in range(8)
+        ]
+        tmp = tempfile.mkdtemp(prefix="locust_recovery_")
+        try:
+            def admit_wall(daemon, corpora) -> float:
+                """Mean submit->ack wall time over the job stream, with
+                dispatch held so queue depth cannot skew the compare."""
+                daemon.scheduler.pause()
+                client = ServeClient(daemon.addr, b"bench-rec",
+                                     timeout=60.0)
+                t0 = time.perf_counter()
+                for i, c in enumerate(corpora):
+                    client.submit(corpus=c, tenant=f"t{i % 3}", config=cfg,
+                                  no_cache=True)
+                return (time.perf_counter() - t0) / len(corpora)
+
+            base = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02))
+            base.serve_in_thread()
+            try:
+                plain_admit_s = admit_wall(base, big)
+            finally:
+                base.close()
+            d1 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02,
+                journal_dir=os.path.join(tmp, "journal_overhead")))
+            d1.serve_in_thread()
+            try:
+                journal_admit_s = admit_wall(d1, big)
+                jstats = d1.journal.stats()
+            finally:
+                d1.close()
+            append_ms = jstats["append_ms_mean"] or 0.0
+            # MTTR phase: ack small jobs, never dispatch them (the
+            # mid-batch window), then an in-process kill -9 — no drain,
+            # no compaction, no close — and a fresh daemon on the same
+            # journal.
+            jdir = os.path.join(tmp, "journal_mttr")
+            dm = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02, journal_dir=jdir))
+            dm.serve_in_thread()
+            admit_wall(dm, small)
+            ids = list(dm._jobs)  # acked, never dispatched: the window
+            dm._shutdown.set()
+            dm.scheduler.stop()
+            dm._sock.close()
+            t0 = time.perf_counter()
+            d2 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02, journal_dir=jdir))
+            d2.serve_in_thread()
+            try:
+                c2 = ServeClient(d2.addr, b"bench-rec", timeout=60.0)
+                first_s = None
+                for jid in ids:
+                    c2.wait(jid, timeout=600.0, poll_s=0.02)
+                    if first_s is None:
+                        first_s = time.perf_counter() - t0
+                all_s = time.perf_counter() - t0
+            finally:
+                d2.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        out = {
+            "overhead_jobs": len(big),
+            "corpus_bytes": len(big[0]),
+            "admit_ms": round(journal_admit_s * 1e3, 3),
+            "admit_ms_no_journal": round(plain_admit_s * 1e3, 3),
+            "journal_append_ms": round(append_ms, 4),
+            "journal_spill_ms": jstats["spill_ms_mean"],
+            # The acceptance ratio (<= 5%): the fsync'd WAL record — the
+            # O(1) cost every admit pays forever — as a share of the
+            # admit latency the client observes.  The corpus spill is
+            # reported beside it: corpus-proportional, dedup'd by sha.
+            "append_overhead_pct": round(
+                100.0 * append_ms / (journal_admit_s * 1e3), 2
+            ) if journal_admit_s > 0 else None,
+            "replayed": len(ids),
+            "mttr_first_result_s": round(first_s, 3),
+            "mttr_all_results_s": round(all_s, 3),
+        }
+        print(
+            f"[bench] recovery: append {out['journal_append_ms']}ms "
+            f"({out['append_overhead_pct']}% of {out['admit_ms']}ms "
+            f"admit, spill {out['journal_spill_ms']}ms), replay "
+            f"{out['replayed']} jobs, first result "
+            f"{out['mttr_first_result_s']}s, all {out['mttr_all_results_s']}s",
+            file=sys.stderr,
+        )
+        from locust_tpu.utils import artifacts
+
+        artifacts.record(
+            artifacts.BENCH_SUBDICT_KINDS["recovery"], dict(out)
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 - the headline line comes first
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _bench_subdict_producers() -> dict:
     """Guarded sub-bench producers, two-sided against the evidence-ledger
     kinds (artifacts.BENCH_SUBDICT_KINDS, same identity discipline as
@@ -758,7 +907,11 @@ def _bench_subdict_producers() -> dict:
     """
     from locust_tpu.utils.artifacts import BENCH_SUBDICT_KINDS
 
-    subdicts = {"dataplane": _dataplane_stats, "serve": _serve_stats}
+    subdicts = {
+        "dataplane": _dataplane_stats,
+        "serve": _serve_stats,
+        "recovery": _recovery_stats,
+    }
     if tuple(subdicts) != tuple(BENCH_SUBDICT_KINDS):
         raise RuntimeError(
             "bench sub-dict producers drifted from "
@@ -926,6 +1079,7 @@ def run_bench(backend: str) -> dict:
         "dataplane": subdicts["dataplane"](),
         "stream": _stream_stats(eng, rows),
         "serve": subdicts["serve"](),
+        "recovery": subdicts["recovery"](),
     }
     if obs_on:
         from locust_tpu import obs

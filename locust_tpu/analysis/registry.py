@@ -1,4 +1,4 @@
-"""The closed rule registry (R001–R012) — itself anti-drift-checked:
+"""The closed rule registry (R001–R013) — itself anti-drift-checked:
 ``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
 pins that every registered rule has firing + silent fixture coverage."""
 
@@ -18,6 +18,7 @@ from locust_tpu.analysis.rules_telemetry import TelemetryRegistryRule
 from locust_tpu.analysis.rules_threads import (
     ThreadLifecycleRule,
     ThreadSharedStateRule,
+    UnboundedBlockingRule,
 )
 from locust_tpu.analysis.rules_traced import (
     DonationHygieneRule,
@@ -38,6 +39,7 @@ _RULE_CLASSES = (
     DonationHygieneRule,        # R010
     ServeErrorRegistryRule,     # R011
     ThreadLifecycleRule,        # R012
+    UnboundedBlockingRule,      # R013
 )
 
 

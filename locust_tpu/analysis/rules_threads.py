@@ -156,3 +156,114 @@ class ThreadLifecycleRule(Rule):
                         "they were built for; scope it or shut it down on "
                         "a reachable close path",
                     )
+
+
+# ------------------------------------------------------------------ R013
+
+# Blocking primitives whose no-timeout form can park a thread forever.
+# join()/wait()/result() are bounded by a timeout ARGUMENT; accept()/
+# recv*() are bounded by the socket's settimeout() deadline instead.
+_ARG_BOUNDED = {"join", "wait", "result"}
+_SOCKET_BOUNDED = {"accept", "recv", "recv_into", "recvfrom"}
+_R013_SCOPES = ("locust_tpu/serve/", "locust_tpu/distributor/")
+
+
+class UnboundedBlockingRule(Rule):
+    """R013 — unbounded-blocking hygiene in the daemon tiers.
+
+    The serve and distributor tiers promise "never a hang": every wait a
+    wedged peer, a dead dispatcher, or a saturated pool can extend must
+    carry a deadline (the ServeClient.wait / dispatcher-join /
+    fetch-pool incidents as a machine check).  Heuristics:
+
+      * ``x.join()`` / ``x.wait()`` / ``x.result()`` with NO positional
+        argument and no ``timeout=`` keyword fire (``",".join(parts)``
+        and ``os.path.join(a, b)`` always pass arguments, so the
+        no-argument form is the thread/future one);
+      * ``x.accept()`` / ``x.recv*(...)`` fire unless the receiver is a
+        function PARAMETER (the caller owns the socket's deadline — the
+        protocol-layer convention) or the enclosing scope visibly calls
+        ``settimeout``;
+      * deliberate unbounded waits take a reason-noqa, like every rule.
+    """
+
+    rule_id = "R013"
+    title = "unbounded blocking call in a daemon tier"
+
+    # Overridable for fixture trees in tests (R004/R009/R011 pattern).
+    scopes = _R013_SCOPES
+
+    def check_file(self, f, root):
+        if not any(f.rel.startswith(p) for p in self.scopes):
+            return
+        for scope in self._scopes_of(f.tree):
+            params = self._params(scope)
+            has_settimeout = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "settimeout"
+                for n in ast.walk(scope)
+            )
+            for node in self._own_calls(scope):
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                leaf = node.func.attr
+                if leaf in _ARG_BOUNDED:
+                    if node.args or any(
+                        kw.arg == "timeout" for kw in node.keywords
+                    ):
+                        continue
+                    yield Finding(
+                        self.rule_id, f.rel, node.lineno, node.col_offset,
+                        f".{leaf}() without a timeout can park this "
+                        "thread forever on a wedged peer/thread — pass "
+                        "a timeout (or reason-noqa a deliberate forever-"
+                        "wait)",
+                    )
+                elif leaf in _SOCKET_BOUNDED:
+                    recv = node.func.value
+                    if isinstance(recv, ast.Name) and recv.id in params:
+                        continue  # caller owns the socket deadline
+                    if has_settimeout:
+                        continue
+                    yield Finding(
+                        self.rule_id, f.rel, node.lineno, node.col_offset,
+                        f".{leaf}() on a socket with no settimeout() in "
+                        "this scope blocks forever on a silent peer — "
+                        "set a deadline before blocking on the wire",
+                    )
+
+    @staticmethod
+    def _scopes_of(tree):
+        """Module + each function body (innermost wins for ownership)."""
+        scopes = [tree]
+        scopes.extend(
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        return scopes
+
+    @staticmethod
+    def _params(scope):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return set()
+        a = scope.args
+        return {
+            p.arg
+            for p in a.args + a.kwonlyargs + a.posonlyargs
+        }
+
+    @staticmethod
+    def _own_calls(scope):
+        """Calls belonging to ``scope`` and not to a nested def (each
+        nested def is its own scope in _scopes_of — reporting a call
+        from both would duplicate findings)."""
+        nested = [
+            n for n in ast.walk(scope)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not scope
+        ]
+        banned = {id(n) for nd in nested for n in ast.walk(nd)}
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and id(n) not in banned:
+                yield n

@@ -596,7 +596,19 @@ def run_job(
             # the job finished and the pool shut down: a failed attempt,
             # not an unhandled thread death.
             raise MasterError(f"fetch pool closed (job ended): {e}")
-        return fut.result()
+        # Bounded wait (R013): the fetch itself is bounded by per-socket
+        # timeouts, but a saturated pool queues this future behind other
+        # transfers — one rpc_timeout of queueing slack on top of the
+        # transfer's own budget keeps a wedged peer from parking this
+        # attempt thread forever.
+        try:
+            return fut.result(timeout=rpc_timeout * 2)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise MasterError(
+                f"fetch of {remote} from {node} did not complete within "
+                f"{rpc_timeout * 2:.0f}s (pool saturated or peer wedged)"
+            )
 
     def try_shard(shard: int, node_idx: int, attempt: int) -> tuple[str, dict]:
         node = cluster[node_idx]

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from locust_tpu import backend as backend_mod
 from locust_tpu import obs
 from locust_tpu.config import DEFAULT_CONFIG, EngineConfig
 from locust_tpu.core import bytes_ops
@@ -727,6 +728,7 @@ class MapReduceEngine:
         rows: np.ndarray,
         checkpoint_dir: str,
         every: int = 8,
+        breaker=None,
     ) -> RunResult:
         """Block-granular fold with crash-resumable snapshots.
 
@@ -738,6 +740,16 @@ class MapReduceEngine:
         crash at any instant resumes without double-folding blocks.  A
         re-run with a different corpus/config fingerprint starts fresh.
         Snapshots are a few MB (table_size rows) regardless of corpus size.
+
+        ``breaker`` (a ``backend.CircuitBreaker``) adds mid-job failover:
+        every primary dispatch runs through ``backend.guarded_dispatch``
+        (the ``backend.dispatch`` chaos site); a failed dispatch reloads
+        the last durable checkpoint — the donated accumulator may have
+        died with the dispatch, the snapshot cannot — and once the
+        breaker is OPEN the fold continues on the CPU fallback device
+        from that checkpoint.  When the half-open probe readmits the
+        primary, the fold migrates back.  Fallback-side failures are
+        REAL failures and re-raise (there is no second fallback).
         """
         from locust_tpu.io.serde import fingerprint_corpus
 
@@ -764,27 +776,120 @@ class MapReduceEngine:
         )
 
         t0 = time.perf_counter()
-        i = start_block - 1
-        last_mark = start_block
+        on_cpu = False
+        cpu_dev = None  # resolved once at first failover, then cached
         try:
-            for i, blk in enumerate(self._blocks(rows)):
-                if i < start_block:
-                    continue
-                acc, blk_overflow, distinct = self._fold_block(acc, blk)
-                overflow = overflow + blk_overflow
-                max_distinct = jnp.maximum(max_distinct, distinct)
-                if (i + 1) % every == 0:
-                    pump.mark(acc, i + 1, overflow, max_distinct)
-                    last_mark = i + 1
-            if i + 1 > last_mark:  # skip the cadence-aligned double write
-                pump.mark(acc, i + 1, overflow, max_distinct)
-            pump.finish()  # final generation durable before returning
+            while True:
+                dispatch_died = None
+                i = start_block - 1
+                last_mark = start_block
+                for i, blk in enumerate(self._blocks(rows)):
+                    if i < start_block:
+                        continue
+                    if breaker is not None:
+                        acc, on_cpu, cpu_dev = self._breaker_place(
+                            breaker, acc, on_cpu, cpu_dev
+                        )
+                    # Only the FOLD dispatch is failover-retryable —
+                    # checkpoint-writer errors re-raised by pump.mark
+                    # must stay loud (retrying them from the same
+                    # checkpoint would loop forever).
+                    try:
+                        if on_cpu:
+                            blk = jax.device_put(blk, cpu_dev)
+                            acc, blk_overflow, distinct = self._fold_block(
+                                acc, blk
+                            )
+                        elif breaker is not None:
+                            acc, blk_overflow, distinct = (
+                                backend_mod.guarded_dispatch(
+                                    breaker,
+                                    partial(self._fold_block, acc, blk),
+                                    block=i, backend="primary",
+                                )
+                            )
+                        else:
+                            acc, blk_overflow, distinct = self._fold_block(
+                                acc, blk
+                            )
+                    except Exception as e:
+                        if breaker is None or on_cpu:
+                            raise  # no breaker, or the FALLBACK died: real
+                        dispatch_died = e
+                        break
+                    overflow = overflow + blk_overflow
+                    max_distinct = jnp.maximum(max_distinct, distinct)
+                    if (i + 1) % every == 0:
+                        pump.mark(acc, i + 1, overflow, max_distinct)
+                        last_mark = i + 1
+                if dispatch_died is None:
+                    if i + 1 > last_mark:  # skip cadence-aligned double write
+                        pump.mark(acc, i + 1, overflow, max_distinct)
+                    pump.finish()  # final generation durable before returning
+                    break
+                if (
+                    breaker.state() != "closed"
+                    and backend_mod.cpu_fallback_device() is None
+                ):
+                    # Tripped breaker and nothing to fail over TO (a
+                    # TPU-only jax process): going around again would
+                    # busy-loop re-reading the same snapshot against a
+                    # dead primary forever — re-raise loud instead (the
+                    # checkpoint survives for a later resume).  state(),
+                    # not allow(): allow() would consume the half-open
+                    # probe token this path never dispatches.
+                    raise dispatch_died
+                # Primary dispatch died (guarded_dispatch recorded the
+                # failure).  The donated accumulator is suspect; the last
+                # checkpoint is not: flush any pending async write
+                # best-effort, reload, and go around — on the primary
+                # while the breaker still allows it, on the CPU fallback
+                # once it is open.
+                try:
+                    pump.finish()
+                except Exception as e:  # noqa: BLE001 - reload decides
+                    logger.warning(
+                        "checkpoint flush during failover failed (%s); "
+                        "resuming from the last durable generation", e,
+                    )
+                start_block, overflow, max_distinct, acc = self._load_state(
+                    state_path, fingerprint,
+                    KVBatch.empty(self._table_size, self.cfg.key_lanes),
+                )
         finally:
             pump.close()
         total_ms = (time.perf_counter() - t0) * 1e3
         return self._finish(
             acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0)
         )
+
+    def _breaker_place(self, breaker, acc, on_cpu: bool, cpu_dev):
+        """Move the fold accumulator to whichever device the breaker
+        currently makes eligible; returns (acc, on_cpu, cpu_dev).  The
+        device is resolved once and cached by the caller (the hot loop
+        must not pay a local_devices lookup per block); the migration
+        copies through ``jax.device_put`` (never a donation), so the
+        reloaded-from-checkpoint table stays jax-owned either way."""
+        primary_ok = breaker.allow()
+        if primary_ok and on_cpu:
+            # Half-open probe (or a closed breaker after recovery): the
+            # next dispatch tries the primary again from the live state.
+            acc = jax.device_put(acc)
+            obs.event("backend.failover", direction="cpu_to_primary")
+            return acc, False, cpu_dev
+        if not primary_ok and not on_cpu:
+            if cpu_dev is None:
+                cpu_dev = backend_mod.cpu_fallback_device()
+            if cpu_dev is None:
+                return acc, False, None  # nothing to fail over to
+            acc = jax.device_put(acc, cpu_dev)
+            obs.event("backend.failover", direction="primary_to_cpu")
+            logger.warning(
+                "backend breaker open: fold continuing on the CPU "
+                "fallback from the last checkpoint"
+            )
+            return acc, True, cpu_dev
+        return acc, on_cpu, cpu_dev
 
     def _finish(self, acc, num_segments, overflow, times,
                 stream: dict | None = None) -> RunResult:

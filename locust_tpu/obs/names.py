@@ -45,6 +45,12 @@ NAMES = {
     "obs.device_join": "event",     # xplane family times joined onto a stage
     "serve.admit": "event",         # serve: job admitted to the queue
     "serve.reject": "event",        # serve: admission rejected (reason code)
+    "serve.retry": "event",         # serve: failed dispatch requeued w/ backoff
+    "serve.replay": "event",        # serve: journal replay summary at startup
+    "backend.breaker_open": "event",       # breaker tripped: primary ineligible
+    "backend.breaker_half_open": "event",  # cooldown over: one probe allowed
+    "backend.breaker_close": "event",      # probe succeeded: primary restored
+    "backend.failover": "event",    # run resumed from checkpoint on fallback
     # --- metrics ------------------------------------------------------
     "job.workers": "gauge",         # cluster size of the running job
     "stream.blocks": "counter",     # blocks folded by run_stream
@@ -58,6 +64,8 @@ NAMES = {
     "serve.exec_cache_hits": "counter",    # warm-executable cache hits
     "serve.exec_cache_misses": "counter",  # ... and compiles/builds paid
     "serve.result_cache_hits": "counter",  # result cache answered a submit
+    "serve.journal_ms": "histogram",  # per-append journal write latency
+    "backend.breaker_trips": "counter",  # closed->open breaker transitions
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")

@@ -35,4 +35,5 @@ from locust_tpu.serve.jobs import (  # noqa: F401
     Job,
     JobSpec,
 )
+from locust_tpu.serve.journal import JobJournal  # noqa: F401
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler  # noqa: F401

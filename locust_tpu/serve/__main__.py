@@ -51,6 +51,10 @@ def _daemon_main(argv) -> int:
     p.add_argument("--warm-dir", default=None,
                    help="persist the result cache across restarts here "
                         "(async snapshot writer, docs/SERVING.md)")
+    p.add_argument("--journal-dir", default=None,
+                   help="write-ahead job journal: accepted jobs survive "
+                        "kill -9 and replay on restart (docs/SERVING.md "
+                        "durability)")
     p.add_argument("--fault-plan", default=None,
                    help="chaos-test fault plan: JSON text or a path "
                         f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
@@ -72,6 +76,7 @@ def _daemon_main(argv) -> int:
             max_batch=args.max_batch,
             tenant_quota=args.tenant_quota,
             warm_dir=args.warm_dir,
+            journal_dir=args.journal_dir,
         ),
     )
     print(f"[serve] listening on {daemon.addr[0]}:{daemon.addr[1]}",
@@ -114,6 +119,12 @@ def _submit_main(argv) -> int:
     p.add_argument("--line-width", type=int, default=None)
     p.add_argument("--key-width", type=int, default=None)
     p.add_argument("--emits-per-line", type=int, default=None)
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="whole-job deadline: expiry anywhere answers the "
+                        "structured deadline_exceeded code")
+    p.add_argument("--max-attempts", type=int, default=None, metavar="N",
+                   help="dispatches this job may kill before it is "
+                        "quarantined as poison_job (default 4)")
     p.add_argument("--invalidate", action="store_true",
                    help="drop any cached result for this job first")
     p.add_argument("--no-wait", action="store_true",
@@ -138,6 +149,7 @@ def _submit_main(argv) -> int:
         corpus=corpus, tenant=args.tenant, workload=args.workload,
         config=config or None, weight=args.weight,
         invalidate=args.invalidate,
+        deadline_s=args.deadline, max_attempts=args.max_attempts,
     )
     print(f"[serve] job {ack['job_id']} {ack['state']}"
           + (" (cached)" if ack.get("cached") else ""), file=sys.stderr)

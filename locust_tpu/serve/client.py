@@ -72,9 +72,15 @@ class ServeClient:
         weight: float = 1.0,
         invalidate: bool = False,
         no_cache: bool = False,
+        deadline_s: float | None = None,
+        max_attempts: int | None = None,
     ) -> dict:
         """Submit one job; returns the daemon's ack ({job_id, state,
-        cached}).  Raises ``ServeError`` on a structured rejection."""
+        cached}).  Raises ``ServeError`` on a structured rejection.
+        ``deadline_s``/``max_attempts`` are the job's robustness budgets
+        (docs/SERVING.md): expiry anywhere answers ``deadline_exceeded``,
+        a job that kills ``max_attempts`` dispatches is quarantined as
+        ``poison_job``."""
         req: dict = {
             "cmd": "submit",
             "tenant": tenant,
@@ -87,6 +93,10 @@ class ServeClient:
             req["invalidate"] = True
         if no_cache:
             req["no_cache"] = True
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if max_attempts is not None:
+            req["max_attempts"] = max_attempts
         if corpus is not None:
             req["corpus_b64"] = base64.b64encode(corpus).decode()
         if path is not None:
@@ -107,21 +117,44 @@ class ServeClient:
         return resp
 
     def wait(self, job_id: str, timeout: float = 120.0,
-             poll_s: float = 0.05) -> dict:
+             poll_s: float = 0.05, max_poll_s: float = 1.0) -> dict:
         """Poll until the job leaves the queue/engine; returns
         ``result()`` on success, raises ``ServeError`` on a structured
         failure or ``TimeoutError`` when the deadline passes (a bounded
-        wait — a wedged daemon must not hang the client)."""
+        wait — a wedged daemon must not hang the client).
+
+        Polling backs off geometrically from ``poll_s`` to ``max_poll_s``
+        with jitter: a fixed interval across N waiting clients
+        synchronizes their status RPCs into daemon-hammering waves, and
+        long jobs do not need 20 polls a second.  The timeout error
+        carries the daemon-reported job state and attempt count — "still
+        retrying (attempt 2/4)" is actionable where a bare "still
+        running after Ns" is not."""
         deadline = time.monotonic() + timeout
+        sleep_s = poll_s
         while True:
             st = self.status(job_id)
             if st["state"] in ("done", "failed", "cancelled"):
                 return self.result(job_id)
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
+                attempts = st.get("attempts")
+                budget = st.get("max_attempts")
+                detail = f"state {st['state']!r}"
+                if attempts is not None and budget is not None:
+                    detail += f", attempt {attempts}/{budget}"
+                if st.get("batch_size"):
+                    detail += f", batch of {st['batch_size']}"
                 raise TimeoutError(
-                    f"job {job_id} still {st['state']} after {timeout}s"
+                    f"job {job_id} not finished after {timeout}s "
+                    f"({detail}); the daemon still holds it — poll "
+                    "status/result again or raise the timeout"
                 )
-            time.sleep(poll_s)
+            # Deterministic-enough jitter without the global RNG: the
+            # fractional spread only needs to decorrelate clients.
+            jitter = 0.5 + (hash((job_id, now)) % 1024) / 2048.0
+            time.sleep(min(sleep_s * jitter, max(deadline - now, 0.001)))
+            sleep_s = min(sleep_s * 1.6, max_poll_s)
 
     def cancel(self, job_id: str) -> dict:
         return self._rpc_ok({"cmd": "cancel", "job_id": job_id})

@@ -56,12 +56,16 @@ from locust_tpu.serve.cache import (
     ResultCache,
     WarmState,
 )
+from locust_tpu.config import EngineConfig
 from locust_tpu.serve.jobs import (
+    WORKLOADS,
     Job,
+    JobSpec,
     parse_spec,
     structured_error,
 )
 from locust_tpu.serve.jobs import pairs_bytes as jobs_pairs_bytes
+from locust_tpu.serve.journal import JobJournal
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler
 from locust_tpu.utils import faultplan
 
@@ -101,6 +105,18 @@ class ServeConfig:
     conn_timeout: float = 30.0
     max_connections: int = 32
     dispatch_poll_s: float = 0.25  # dispatcher wake cadence when idle
+    # Durability (docs/SERVING.md): the write-ahead job journal.  With a
+    # journal_dir set, every accepted job is fsync'd to disk BEFORE its
+    # accept ack, and a restart replays unfinished jobs under their
+    # original ids — kill -9 mid-batch loses no acked work.
+    journal_dir: str | None = None
+    journal_fsync: bool = True       # False trades the kill -9 window for speed
+    journal_compact_every: int = 512  # appends between journal compactions
+    # Retry ladder (docs/SERVING.md): exponential backoff base/cap for
+    # failed dispatches.  Attempts per job are bounded by the SPEC's
+    # max_attempts; these bound how long each wait between them is.
+    retry_base_s: float = 0.2
+    retry_cap_s: float = 5.0
 
 
 class ServeDaemon:
@@ -140,6 +156,15 @@ class ServeDaemon:
         )
         if self.warm is not None:
             self.warm.load()
+        self.journal = (
+            JobJournal(
+                self.cfg.journal_dir,
+                fsync=self.cfg.journal_fsync,
+                compact_every=self.cfg.journal_compact_every,
+            )
+            if self.cfg.journal_dir
+            else None
+        )
         self._lock = threading.Lock()
         self._jobs: dict[str, Job] = {}       # insertion order = age
         self._corpus_bytes: dict[str, bytes] = {}  # job_id -> in-flight bytes
@@ -157,6 +182,11 @@ class ServeDaemon:
         self.addr = self._sock.getsockname()
         self._shutdown = threading.Event()
         self._closed = False
+        # Replay BEFORE the dispatcher exists: re-enqueued jobs must be
+        # fully staged (record + corpus) before anything can pop them —
+        # the same record-before-admit ordering the submit path keeps.
+        if self.journal is not None:
+            self._replay_journal()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serve-dispatch", daemon=True
         )
@@ -250,6 +280,15 @@ class ServeDaemon:
                 # _closed, so an escape here is permanently unretryable).
                 logger.exception("serve final warm mark failed")
             self.warm.close()
+        if self.journal is not None:
+            # Clean shutdown leaves a compact journal: stranded jobs were
+            # just failed structured above, so nothing is live and the
+            # next start replays an (almost) empty log.
+            try:
+                self._compact_journal()
+            except Exception:  # noqa: BLE001 - best-effort at teardown
+                logger.exception("serve journal compaction failed at close")
+            self.journal.close()
 
     def _serve_one(self, conn: socket.socket) -> None:
         try:
@@ -384,6 +423,7 @@ class ServeDaemon:
             n_lines=n_lines,
             n_blocks=n_blocks,
             bucket=bucket,
+            config_overrides=dict(req.get("config") or {}),
         )
         if not spec.no_cache and not spec.invalidate:
             hit = self.results.get_with_meta(digest, spec_fp)
@@ -436,12 +476,46 @@ class ServeDaemon:
                 f"buffered corpus bytes at cap "
                 f"({self.cfg.max_queue_bytes}); retry with backoff",
             )
+        # Write-ahead append BEFORE the scheduler sees the job and BEFORE
+        # the ack leaves: the record is what makes the ack a durable
+        # promise (docs/SERVING.md).  An append that fails must become a
+        # structured rejection — acking unjournaled work would silently
+        # demote the durability guarantee.
+        if self.journal is not None:
+            try:
+                self.journal.append_admit(job, corpus)
+            except faultplan.FaultInjected:
+                with self._lock:
+                    self._jobs.pop(job.job_id, None)
+                    self._corpus_pop(job.job_id)
+                obs.event("serve.reject", code="fault_injected")
+                return structured_error(
+                    "fault_injected",
+                    "[faultplan] injected journal crash at append — "
+                    "the job was never acked; retry",
+                )
+            except Exception as e:  # noqa: BLE001 - disk full/permission
+                logger.exception("serve journal append failed")
+                with self._lock:
+                    self._jobs.pop(job.job_id, None)
+                    self._corpus_pop(job.job_id)
+                obs.event("serve.reject", code="journal_failed")
+                return structured_error(
+                    "journal_failed",
+                    f"write-ahead journal append failed "
+                    f"({type(e).__name__}: {e}); the accept ack would "
+                    "not be durable — fix the journal volume and retry",
+                )
         try:
             self.scheduler.admit(job)
         except AdmitReject as e:
             with self._lock:
                 self._jobs.pop(job.job_id, None)
                 self._corpus_pop(job.job_id)
+            if self.journal is not None:
+                # Tombstone so replay cannot resurrect a job the client
+                # was told is NOT in the system.
+                self.journal.append_state(job.job_id, "rejected")
             obs.event("serve.reject", code=e.code)
             return structured_error(e.code, str(e))
         if spec.invalidate:
@@ -569,6 +643,13 @@ class ServeDaemon:
                     "cancelled", "cancelled while queued"
                 )
                 self._corpus_pop(job.job_id)
+            if self.journal is not None:
+                # The error payload rides the record: replay restores the
+                # job's structured code as "cancelled", not a generic
+                # failure a client's .code switch would mishandle.
+                self.journal.append_state(
+                    job.job_id, "cancelled", error=job.error
+                )
             return {"status": "ok", "cancelled": True, "state": "cancelled"}
         # Running/finished jobs are past the point of no return — report
         # the state, don't pretend.
@@ -615,12 +696,21 @@ class ServeDaemon:
             "exec_cache": self.executables.stats(),
             "result_cache": self.results.stats(),
             "warm": self.warm.stats() if self.warm is not None else None,
+            "journal": (
+                self.journal.stats() if self.journal is not None else None
+            ),
         }
 
     # ----------------------------------------------------------- dispatch
 
     def _batch_key(self, job: Job):
-        return (self.executables.engine_key(job.spec), job.bucket)
+        # bisect_group keeps the halves of a failed batch from
+        # re-coalescing (jobs.Job.bisect_group): None for never-failed
+        # jobs, so the common path batches exactly as before.
+        return (
+            self.executables.engine_key(job.spec), job.bucket,
+            job.bisect_group,
+        )
 
     def _dispatch_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -629,7 +719,27 @@ class ServeDaemon:
             except Exception:  # noqa: BLE001 - the dispatcher must survive
                 logger.exception("serve dispatch iteration failed")
 
+    def _sweep_deadlines(self) -> None:
+        """Expire queued/retrying jobs whose deadline passed — the
+        structured ``deadline_exceeded`` answer must not wait for a
+        dispatch slot the job will never productively use."""
+        expired = self.scheduler.expire(time.monotonic())
+        if not expired:
+            return
+        with self._lock:
+            for j in expired:
+                self._corpus_pop(j.job_id)
+        self._fail_jobs([
+            (j, structured_error(
+                "deadline_exceeded",
+                f"deadline of {j.spec.deadline_s}s expired while "
+                f"{j.state} (attempt {j.attempts}/{j.spec.max_attempts})",
+            ))
+            for j in expired
+        ])
+
     def _dispatch_once(self) -> None:
+        self._sweep_deadlines()
         # Only an occupied queue is worth a queue-wait span: an idle
         # daemon's poll ticks would bury the timeline in no-op spans.
         cm = (
@@ -673,17 +783,27 @@ class ServeDaemon:
                 return
         # Chaos: the dispatch boundary (docs/FAULTS.md).  "crash" models
         # the dispatch dying mid-flight, "error" an engine-side failure:
-        # either way every job in the batch fails with a STRUCTURED
-        # error (never a silent wrong answer) and the daemon lives on.
+        # either way the batch enters the retry/bisection ladder — every
+        # TERMINAL failure is a STRUCTURED error (never a silent wrong
+        # answer) and the daemon lives on.  When no batch-level rule
+        # matches, one sub-fire per job carries job=<id> so a plan can
+        # target ONE poison job (the bisection tests ride this).
         rule = faultplan.fire("serve.dispatch", jobs=len(jobs))
+        if rule is None:
+            for j in jobs:
+                rule = faultplan.fire(
+                    "serve.dispatch", jobs=len(jobs), job=j.job_id
+                )
+                if rule is not None:
+                    break
         if rule is not None:
             if rule.action == "delay":
                 time.sleep(rule.delay_s)
             else:
-                self._fail_batch(jobs, structured_error(
-                    "fault_injected",
+                self._retry_or_fail(
+                    jobs, corpora,
                     f"[faultplan] injected dispatch {rule.action}",
-                ))
+                )
                 return
         spec = jobs[0].spec
         njobs_padded = batching.bucket_blocks(len(jobs))
@@ -717,6 +837,32 @@ class ServeDaemon:
                 for job, res in zip(jobs, results):
                     pairs = res.to_host_pairs()
                     size = jobs_pairs_bytes(pairs)
+                    if job.expired(done):
+                        # Deadline expiry ANYWHERE answers structured
+                        # deadline_exceeded — even when the result just
+                        # landed: the client stopped waiting at the
+                        # budget it set.  The correct result still feeds
+                        # the result cache below, so a resubmit of the
+                        # same work is answered instantly.
+                        self._fail_jobs([(job, structured_error(
+                            "deadline_exceeded",
+                            f"deadline of {job.spec.deadline_s}s expired "
+                            "while the job was running; the result was "
+                            "cached — resubmit to fetch it",
+                        ))])
+                        if not job.spec.no_cache:
+                            self.results.put(
+                                job.corpus_digest, job.spec.fingerprint(),
+                                pairs,
+                                meta={
+                                    "distinct": res.num_segments,
+                                    "truncated": bool(res.truncated),
+                                    "overflow_tokens": int(
+                                        res.overflow_tokens
+                                    ),
+                                },
+                            )
+                        continue
                     with self._lock:
                         # state flips to "done" LAST: status/result
                         # handlers read job fields without this lock, so
@@ -733,7 +879,6 @@ class ServeDaemon:
                         job.overflow_tokens = int(res.overflow_tokens)
                         job.state = "done"
                         self._completed += 1
-                        completed = self._completed
                         self._result_bytes += size
                         self._evict_history(keep=job.job_id)
                     if not job.spec.no_cache:
@@ -745,13 +890,13 @@ class ServeDaemon:
                                 "overflow_tokens": job.overflow_tokens,
                             },
                         )
+                    if self.journal is not None:
+                        self.journal.append_state(job.job_id, "done")
                     obs.metric_inc("serve.jobs")
                     obs.metric_observe("serve.latency_ms", job.latency_ms())
-        except Exception as e:  # noqa: BLE001 - jobs fail, daemon survives
+        except Exception as e:  # noqa: BLE001 - jobs retry/fail, daemon survives
             logger.exception("serve dispatch failed")
-            self._fail_batch(jobs, structured_error(
-                "dispatch_failed", f"{type(e).__name__}: {e}"
-            ))
+            self._retry_or_fail(jobs, corpora, f"{type(e).__name__}: {e}")
             return
         if self.warm is not None:
             # Latest-wins background generation: the dispatcher never
@@ -763,18 +908,135 @@ class ServeDaemon:
             # cadence to "clean shutdown only".  The cursor read+write
             # holds the lock (close() snapshots the generation counter
             # under it); the mark itself stays outside — it only enqueues
-            # on the async writer.
+            # on the async writer.  ``completed`` is re-read here, not
+            # carried from the demux loop: a batch whose every job
+            # deadline-expired at demux completes nothing.
             with self._lock:
+                completed = self._completed
                 due = completed - self._warm_marked >= self.cfg.warm_every
                 if due:
                     self._warm_marked = completed
             if due:
                 self.warm.mark(completed)
+        if self.journal is not None and self.journal.compact_due():
+            self._compact_journal()
+
+    # ---------------------------------------------------- retry/fail/journal
+
+    @staticmethod
+    def _retry_jitter(job_id: str, attempt: int) -> float:
+        """Deterministic jitter fraction in [0, 1): same job + attempt ->
+        same jitter on every run (the chaos matrix stays reproducible),
+        different jobs -> decorrelated retries (no thundering herd)."""
+        h = hashlib.sha256(f"{job_id}:{attempt}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def _retry_or_fail(
+        self, jobs: list[Job], corpora: dict, reason: str
+    ) -> None:
+        """One failed dispatch enters the retry ladder (docs/SERVING.md):
+
+          * a multi-job batch BISECTS — the halves get distinct
+            ``bisect_group`` tags so they can never re-coalesce, which
+            isolates a poison job in log2(batch) extra dispatches while
+            its innocent neighbors succeed on their own half;
+          * each surviving job requeues with exponential backoff +
+            deterministic jitter, bounded by its ``max_attempts`` budget
+            and its deadline;
+          * a job that exhausts attempts with its LAST kill being a SOLO
+            dispatch is quarantined as structured ``poison_job`` (it
+            demonstrably kills dispatches on its own); otherwise the
+            terminal code is ``dispatch_failed``;
+          * deadline expiry at any rung answers ``deadline_exceeded``.
+        """
+        now = time.monotonic()
+        alive = [j for j in jobs if j.state != "done"]  # demuxed: stands
+        solo = len(alive) == 1
+        if len(alive) > 1:
+            tag = uuid.uuid4().hex[:6]
+            half = (len(alive) + 1) // 2
+            for k, job in enumerate(alive):
+                side = "L" if k < half else "R"
+                job.bisect_group = f"{tag}.{side}"
+        failures: list[tuple[Job, dict]] = []
+        for job in alive:
+            job.attempts += 1
+            if job.expired(now):
+                failures.append((job, structured_error(
+                    "deadline_exceeded",
+                    f"deadline of {job.spec.deadline_s}s expired after a "
+                    f"failed dispatch (attempt {job.attempts}/"
+                    f"{job.spec.max_attempts}; last error: {reason})",
+                )))
+                continue
+            if job.attempts >= job.spec.max_attempts:
+                if solo:
+                    failures.append((job, structured_error(
+                        "poison_job",
+                        f"job killed {job.attempts} dispatch(es), the "
+                        f"last one solo — quarantined (last error: "
+                        f"{reason}); inspect the spec/corpus before "
+                        "resubmitting",
+                    )))
+                else:
+                    failures.append((job, structured_error(
+                        "dispatch_failed",
+                        f"dispatch failed {job.attempts} time(s), retry "
+                        f"budget exhausted (last error: {reason})",
+                    )))
+                continue
+            backoff = min(
+                self.cfg.retry_cap_s,
+                self.cfg.retry_base_s * 2.0 ** (job.attempts - 1),
+            )
+            backoff *= 1.0 + self._retry_jitter(job.job_id, job.attempts)
+            not_before = now + backoff
+            dm = job.deadline_mono()
+            if dm is not None and not_before >= dm:
+                failures.append((job, structured_error(
+                    "deadline_exceeded",
+                    f"deadline of {job.spec.deadline_s}s cannot fit "
+                    f"another attempt after {job.attempts} failure(s) "
+                    f"(last error: {reason})",
+                )))
+                continue
+            data = corpora.get(job.corpus_digest)
+            if data is None:
+                failures.append((job, structured_error(
+                    "dispatch_failed",
+                    "in-flight corpus bytes missing at retry (daemon "
+                    f"bug) — resubmit (last error: {reason})",
+                )))
+                continue
+            with self._lock:
+                if job.job_id not in self._corpus_bytes:
+                    self._corpus_put(job.job_id, data)
+                job.state = "retrying"
+            if not self.scheduler.requeue(job, not_before):
+                with self._lock:
+                    self._corpus_pop(job.job_id)
+                failures.append((job, structured_error(
+                    "shutting_down",
+                    "daemon shut down before this job could retry; "
+                    "resubmit after it returns",
+                )))
+                continue
+            obs.event(
+                "serve.retry",
+                job=job.job_id, attempt=job.attempts,
+                backoff_ms=round(backoff * 1e3, 1),
+                group=job.bisect_group,
+            )
+        if failures:
+            self._fail_jobs(failures)
 
     def _fail_batch(self, jobs: list[Job], error: dict) -> None:
+        self._fail_jobs([(j, error) for j in jobs])
+
+    def _fail_jobs(self, failures: list[tuple[Job, dict]]) -> None:
         now = time.monotonic()
         with self._lock:
-            for job in jobs:
+            for job, error in failures:
                 if job.state == "done":
                     continue  # demuxed before the failure: result stands
                 # error before state: the state write is the lock-free
@@ -782,3 +1044,188 @@ class ServeDaemon:
                 job.error = dict(error)
                 job.finished_s = now
                 job.state = "failed"
+        if self.journal is not None:
+            for job, error in failures:
+                if job.state == "failed":
+                    self.journal.append_state(
+                        job.job_id, "failed", error=error
+                    )
+
+    def _compact_journal(self) -> None:
+        """Rewrite the journal down to the still-live jobs (and GC their
+        orphaned corpus spills).  Liveness comes from the journal's OWN
+        records under its lock (journal.compact) — a daemon-side job
+        snapshot would race handler-thread admits fsync'd between the
+        snapshot and the rewrite, silently dropping acked work."""
+        self.journal.compact()
+
+    def _replay_journal(self) -> None:
+        """Crash recovery: re-enqueue every journaled job still owed an
+        answer, under its ORIGINAL id (docs/SERVING.md durability):
+
+          * terminal ``failed``/``cancelled`` records are restored as
+            finished history, so a result fetch across the restart reads
+            the same structured error;
+          * ``done`` jobs whose (corpus sha, spec) is in the restored
+            result cache are restored as done — byte-identical replay;
+            done jobs the warm state had not yet persisted RE-ENQUEUE
+            (the fold is deterministic, so the recompute is
+            byte-identical too);
+          * everything else re-enqueues from its spilled corpus; a
+            missing/damaged spill is a structured failure, never a
+            silent loss.  Deadline budgets re-anchor at replay time.
+        """
+        entries = self.journal.replay()
+        requeued = restored = failed = dropped = 0
+        now = time.monotonic()
+        for entry in entries:
+            rec = entry.admit
+            term = entry.terminal
+            if term is not None and term["state"] == "rejected":
+                dropped += 1
+                continue
+            try:
+                if rec["workload"] not in WORKLOADS:
+                    raise ValueError(f"workload {rec['workload']!r}")
+                overrides = dict(rec.get("config") or {})
+                spec = JobSpec(
+                    tenant=str(rec["tenant"]),
+                    workload=str(rec["workload"]),
+                    cfg=EngineConfig(**overrides),
+                    weight=float(rec.get("weight", 1.0)),
+                    no_cache=bool(rec.get("no_cache")),
+                    deadline_s=rec.get("deadline_s"),
+                    max_attempts=int(rec.get("max_attempts", 4)),
+                )
+                n_lines = int(rec["n_lines"])
+                n_blocks, bucket = batching.job_shape(n_lines, spec.cfg)
+                job = Job(
+                    job_id=str(rec["job_id"]),
+                    spec=spec,
+                    corpus_digest=str(rec["corpus_sha"]),
+                    n_lines=n_lines,
+                    n_blocks=n_blocks,
+                    bucket=bucket,
+                    config_overrides=overrides,
+                )
+            except Exception as e:  # noqa: BLE001 - one bad record
+                logger.warning(
+                    "journal replay: admit record unusable (%s: %s)",
+                    type(e).__name__, e,
+                )
+                # The job was ACKED: silently dropping it answers
+                # unknown_job, against the every-acked-job-answers
+                # guarantee.  Remember it as failed with a structured
+                # reason instead (a placeholder spec carries the record
+                # through status/result; nothing ever dispatches it),
+                # and journal the terminal state so compaction drops it.
+                job_id = str(rec.get("job_id") or "")
+                if not job_id:
+                    dropped += 1
+                    continue
+                ghost = Job(
+                    job_id=job_id,
+                    spec=JobSpec(
+                        tenant=str(rec.get("tenant", "default")),
+                        workload="wordcount",
+                        cfg=EngineConfig(),
+                    ),
+                    corpus_digest=str(rec.get("corpus_sha", "")),
+                    n_lines=0, n_blocks=1, bucket=1,
+                )
+                ghost.error = structured_error(
+                    "dispatch_failed",
+                    f"journal admit record unusable after restart "
+                    f"({type(e).__name__}: {e}) — resubmit",
+                )
+                ghost.finished_s = now
+                ghost.state = "failed"
+                with self._lock:
+                    self._remember(ghost)
+                self.journal.append_state(
+                    job_id, "failed", error=ghost.error
+                )
+                failed += 1
+                continue
+            if term is not None and term["state"] in ("failed", "cancelled"):
+                job.state = term["state"]
+                # Fallback code keyed by the terminal STATE: an old
+                # record with no error payload must not rewrite a
+                # cancellation into a dispatch failure — clients switch
+                # on .code (docs/SERVING.md).
+                job.error = dict(term.get("error") or structured_error(
+                    "cancelled" if term["state"] == "cancelled"
+                    else "dispatch_failed",
+                    f"{term['state']} before the restart",
+                ))
+                job.finished_s = now
+                with self._lock:
+                    self._remember(job)
+                restored += 1
+                continue
+            if term is not None and term["state"] == "done":
+                hit = self.results.get_with_meta(
+                    job.corpus_digest, spec.fingerprint()
+                )
+                if hit is not None:
+                    pairs, meta = hit
+                    job.state = "done"
+                    job.cache = "result"
+                    job.started_s = job.submitted_s
+                    job.finished_s = now
+                    job.result = pairs
+                    job.result_bytes = jobs_pairs_bytes(pairs)
+                    job.distinct = int(meta.get("distinct", len(pairs)))
+                    job.truncated = bool(meta.get("truncated", False))
+                    job.overflow_tokens = int(
+                        meta.get("overflow_tokens", 0)
+                    )
+                    with self._lock:
+                        self._result_bytes += job.result_bytes
+                        self._remember(job)
+                    restored += 1
+                    continue
+                # done but not persisted: fall through and recompute.
+            corpus = self.journal.read_spill(job.corpus_digest)
+            if corpus is None:
+                job.error = structured_error(
+                    "dispatch_failed",
+                    "corpus spill missing or damaged after restart — "
+                    "resubmit",
+                )
+                job.finished_s = now
+                job.state = "failed"
+                with self._lock:
+                    self._remember(job)
+                # Terminal record so compaction retires the admit — the
+                # spill is gone, so every future replay would fail the
+                # same way forever.
+                self.journal.append_state(
+                    job.job_id, "failed", error=job.error
+                )
+                failed += 1
+                continue
+            with self._lock:
+                self._remember(job)
+                self._corpus_put(job.job_id, corpus)
+            self.scheduler.requeue(job, 0.0)
+            if entry.terminal is not None:
+                # A done-but-unpersisted job re-enqueues past its own
+                # terminal record: a fresh admit append re-asserts
+                # liveness (both compact and replay treat the LAST
+                # record sequence as truth), otherwise compaction would
+                # retire it mid-rerun and a second crash would lose it.
+                self.journal.append_admit(job, corpus)
+            requeued += 1
+        self.journal.compact()
+        if requeued or restored or failed or dropped:
+            obs.event(
+                "serve.replay",
+                requeued=requeued, restored=restored,
+                failed=failed, dropped=dropped,
+            )
+            logger.info(
+                "journal replay: %d job(s) re-enqueued, %d restored "
+                "finished, %d failed structured, %d dropped",
+                requeued, restored, failed, dropped,
+            )

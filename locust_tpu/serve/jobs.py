@@ -18,8 +18,10 @@ import time
 from locust_tpu.config import EngineConfig
 
 # Job lifecycle (reported verbatim by the ``status`` command):
-#   queued -> running -> done | failed;  queued -> cancelled.
-JOB_STATES = ("queued", "running", "done", "failed", "cancelled")
+#   queued -> running -> done | failed;  queued -> cancelled;
+#   running -> retrying -> running (backoff requeue after a failed
+#   dispatch, docs/SERVING.md retry ladder) until done | failed.
+JOB_STATES = ("queued", "running", "retrying", "done", "failed", "cancelled")
 
 # Closed reason-code registry for every structured error the daemon can
 # hand a client (same closed-registry stance as faultplan.SITES and the
@@ -38,7 +40,16 @@ ERROR_CODES = (
     "not_done",          # result requested before the job finished
     "result_too_large",  # reply frame would exceed protocol.MAX_FRAME
     "unknown_command",   # command outside the serve command set
+    "deadline_exceeded", # the job's deadline_s budget expired (any state)
+    "poison_job",        # the job killed max_attempts dispatches; quarantined
+    "journal_failed",    # WAL append failed; the accept ack would be a lie
 )
+
+# Retry-budget guard rails: a submit may not ask for more attempts than
+# the bisection ladder can meaningfully use (log2(max_batch) + retries),
+# nor a deadline past what admission control can reason about.
+MAX_ATTEMPTS_CAP = 16
+DEADLINE_CAP_S = 3600.0
 
 # workload name -> (map_fn import path resolved lazily in cache.py,
 # combine).  Lazy: resolving here would pull jax into every importer.
@@ -78,6 +89,16 @@ class JobSpec:
     weight: float = 1.0
     invalidate: bool = False  # drop any cached result for this key first
     no_cache: bool = False    # compute fresh AND don't store the result
+    # Durability/robustness budgets (docs/SERVING.md): deadline_s bounds
+    # the job's whole submit->answer life (None = no deadline) —
+    # expiry ANYWHERE (queued, running, retrying) answers the structured
+    # ``deadline_exceeded`` code; max_attempts bounds how many dispatches
+    # the job may kill before it is quarantined as ``poison_job``.  The
+    # default of 4 lets the bisection ladder isolate a poison job out of
+    # a full default batch (8 -> 4 -> 2 -> solo).  Neither is part of
+    # ``fingerprint()``: budgets do not change the executable.
+    deadline_s: float | None = None
+    max_attempts: int = 4
 
     def fingerprint(self) -> str:
         # Memoized like EngineConfig.fingerprint(): the daemon asks at
@@ -156,6 +177,26 @@ def parse_spec(
         raise ValueError("bad_spec\nweight must be a number")
     if not 0.0 < weight <= 100.0:
         raise ValueError(f"bad_spec\nweight must be in (0, 100], got {weight}")
+    deadline_s = req.get("deadline_s")
+    if deadline_s is not None:
+        try:
+            deadline_s = float(deadline_s)
+        except (TypeError, ValueError):
+            raise ValueError("bad_spec\ndeadline_s must be a number")
+        if not 0.0 < deadline_s <= DEADLINE_CAP_S:
+            raise ValueError(
+                f"bad_spec\ndeadline_s must be in (0, {DEADLINE_CAP_S}], "
+                f"got {deadline_s}"
+            )
+    try:
+        max_attempts = int(req.get("max_attempts", 4))
+    except (TypeError, ValueError):
+        raise ValueError("bad_spec\nmax_attempts must be an integer")
+    if not 1 <= max_attempts <= MAX_ATTEMPTS_CAP:
+        raise ValueError(
+            f"bad_spec\nmax_attempts must be in [1, {MAX_ATTEMPTS_CAP}], "
+            f"got {max_attempts}"
+        )
     tenant = str(req.get("tenant", "default"))[:64] or "default"
     spec = JobSpec(
         tenant=tenant,
@@ -164,6 +205,8 @@ def parse_spec(
         weight=weight,
         invalidate=bool(req.get("invalidate")),
         no_cache=bool(req.get("no_cache")),
+        deadline_s=deadline_s,
+        max_attempts=max_attempts,
     )
     return spec, corpus
 
@@ -204,6 +247,27 @@ class Job:
     truncated: bool = False
     overflow_tokens: int = 0
     batch_size: int | None = None         # jobs coalesced into its dispatch
+    attempts: int = 0                     # dispatches this job has ridden
+    # Bisection tag (docs/SERVING.md): jobs from a failed multi-job batch
+    # split into halves that must not re-coalesce — the dispatcher's
+    # batch_key includes this, so a poison job is isolated in log2(batch)
+    # extra dispatches while its innocent neighbors succeed.
+    bisect_group: str | None = None
+    # Raw submit ``config`` overrides, kept for the write-ahead journal:
+    # replay rebuilds the EngineConfig from exactly what the client sent.
+    config_overrides: dict | None = None
+
+    def deadline_mono(self) -> float | None:
+        """Absolute monotonic deadline, or None.  Anchored at submit
+        time — replay re-anchors (a restart restores the job, not the
+        wall-clock budget it already burned; docs/SERVING.md)."""
+        if self.spec.deadline_s is None:
+            return None
+        return self.submitted_s + self.spec.deadline_s
+
+    def expired(self, now: float) -> bool:
+        d = self.deadline_mono()
+        return d is not None and now >= d
 
     def queue_ms(self) -> float | None:
         if self.started_s is None:
@@ -231,5 +295,8 @@ class Job:
             "queue_ms": self.queue_ms(),
             "latency_ms": self.latency_ms(),
             "batch_size": self.batch_size,
+            "attempts": self.attempts,
+            "max_attempts": self.spec.max_attempts,
+            "deadline_s": self.spec.deadline_s,
             "error": self.error,
         }

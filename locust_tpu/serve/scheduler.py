@@ -30,6 +30,7 @@ pops; all state mutates under one condition variable.
 from __future__ import annotations
 
 import threading
+import time
 
 from locust_tpu.serve.jobs import Job
 
@@ -62,6 +63,12 @@ class FairScheduler:
         self.tenant_quota = tenant_quota
         self._cond = threading.Condition()
         self._pending: list[Job] = []  # submit order; fairness picks by vt
+        # Backoff parking lot (docs/SERVING.md retry ladder): jobs
+        # requeued after a failed dispatch wait out their not-before
+        # time here, promoted into _pending by the dispatcher's next
+        # poll.  Counted against max_queue and the tenant quota — a
+        # retrying job still occupies its admission slot.
+        self._delayed: list[tuple[float, Job]] = []
         self._vt: dict[str, float] = {}
         # Global virtual time: the vt of the most-behind tenant at each
         # dispatch, monotone.  It is the rejoin floor when the queue is
@@ -86,17 +93,20 @@ class FairScheduler:
                 # that will never accept again.
                 self._rejected += 1
                 raise AdmitReject("shutting_down", "scheduler is shut down")
-            if len(self._pending) >= self.max_queue:
+            occupied = len(self._pending) + len(self._delayed)
+            if occupied >= self.max_queue:
                 self._rejected += 1
                 raise AdmitReject(
                     "queue_full",
-                    f"queue full ({len(self._pending)}/{self.max_queue} "
+                    f"queue full ({occupied}/{self.max_queue} "
                     "jobs pending); retry with backoff",
                 )
             tenant = job.spec.tenant
             if self.tenant_quota is not None:
                 mine = sum(
                     1 for j in self._pending if j.spec.tenant == tenant
+                ) + sum(
+                    1 for _, j in self._delayed if j.spec.tenant == tenant
                 )
                 if mine >= self.tenant_quota:
                     self._rejected += 1
@@ -106,7 +116,8 @@ class FairScheduler:
                         f"jobs (quota {self.tenant_quota})",
                     )
             if tenant not in self._vt or not any(
-                j.spec.tenant == tenant for j in self._pending
+                j.spec.tenant == tenant
+                for j in self._pending + [d[1] for d in self._delayed]
             ):
                 # (Re)joining tenant: no banked share from idle time.
                 active = [
@@ -120,7 +131,54 @@ class FairScheduler:
             self._admitted += 1
             self._cond.notify_all()
 
+    def requeue(self, job: Job, not_before: float = 0.0) -> bool:
+        """Put an already-admitted job back for another dispatch attempt
+        after ``not_before`` (monotonic).  Skips the admission caps — the
+        job holds its slot from the original admit; rejecting a retry
+        would double-charge the tenant.  False when the scheduler is
+        stopped (the caller fails the job structured ``shutting_down``).
+        """
+        with self._cond:
+            if self._stopped:
+                return False
+            tenant = job.spec.tenant
+            if tenant not in self._vt:
+                self._vt[tenant] = self._global_vt
+            if not_before <= time.monotonic():
+                self._pending.append(job)
+            else:
+                self._delayed.append((not_before, job))
+            self._cond.notify_all()
+            return True
+
+    def expire(self, now: float) -> list[Job]:
+        """Remove and return queued/retrying jobs whose deadline passed —
+        the dispatcher's sweep turns them into structured
+        ``deadline_exceeded`` answers (a job must never sit in the queue
+        past a budget the client stopped waiting on)."""
+        with self._cond:
+            dead = [j for j in self._pending if j.expired(now)]
+            for j in dead:
+                self._pending.remove(j)
+            dead_delayed = [
+                (nb, j) for nb, j in self._delayed if j.expired(now)
+            ]
+            for item in dead_delayed:
+                self._delayed.remove(item)
+            return dead + [j for _, j in dead_delayed]
+
     # ----------------------------------------------------------- dispatch
+
+    def _promote_ripe(self) -> None:
+        """Move delayed jobs whose backoff expired into the dispatch
+        pool.  Caller holds the condition."""
+        if not self._delayed:
+            return
+        now = time.monotonic()
+        ripe = [item for item in self._delayed if item[0] <= now]
+        for item in ripe:
+            self._delayed.remove(item)
+            self._pending.append(item[1])
 
     def _fair_order(self) -> list[Job]:
         """Pending jobs in dispatch-fair order: tenants by vt (ties by
@@ -144,9 +202,11 @@ class FairScheduler:
         followers join in fair order only if their key matches.
         """
         with self._cond:
+            self._promote_ripe()
             while (not self._pending or self._paused) and not self._stopped:
                 if not self._cond.wait(timeout=timeout):
                     return None
+                self._promote_ripe()
             if self._stopped or not self._pending or self._paused:
                 # Stopped beats a non-empty queue: stop() must never be
                 # answered with a fresh dispatch (close() is waiting on
@@ -179,8 +239,14 @@ class FairScheduler:
             # rejoin would re-enter at the floor anyway, so the entry
             # carries no information — and tenant names are CLIENT
             # chosen, so an unpruned dict grows daemon memory (and every
-            # stats reply) without bound.
-            pending_tenants = {j.spec.tenant for j in self._pending}
+            # stats reply) without bound.  Backoff-parked jobs count as
+            # pending here: pruning a tenant whose only jobs are in
+            # _delayed would re-enter it at vt 0.0 when they ripen — a
+            # banked burst that wins every fair pick until it re-catches
+            # the floor, the exact starvation this scheduler forbids.
+            pending_tenants = {j.spec.tenant for j in self._pending} | {
+                j.spec.tenant for _, j in self._delayed
+            }
             for t in [
                 t for t, v in self._vt.items()
                 if t not in pending_tenants and v <= self._global_vt
@@ -198,6 +264,10 @@ class FairScheduler:
                 if j.job_id == job_id:
                     self._pending.remove(j)
                     return j
+            for item in self._delayed:
+                if item[1].job_id == job_id:
+                    self._delayed.remove(item)
+                    return item[1]
             return None
 
     def stop(self) -> None:
@@ -211,8 +281,9 @@ class FairScheduler:
         still queued would otherwise be abandoned in state "queued" with
         no structured answer.  Call after the dispatcher has exited."""
         with self._cond:
-            drained = list(self._pending)
+            drained = list(self._pending) + [j for _, j in self._delayed]
             self._pending.clear()
+            self._delayed.clear()
             return drained
 
     def pause(self) -> None:
@@ -236,10 +307,11 @@ class FairScheduler:
             self._rejected += 1
 
     def depth(self) -> int:
-        """Pending-job count only — the dispatcher's idle-tick probe
-        (stats() builds per-tenant dicts; too heavy for 4x/second)."""
+        """Pending + backoff-parked job count — the dispatcher's
+        idle-tick probe (stats() builds per-tenant dicts; too heavy for
+        4x/second)."""
         with self._cond:
-            return len(self._pending)
+            return len(self._pending) + len(self._delayed)
 
     def stats(self) -> dict:
         with self._cond:
@@ -248,6 +320,7 @@ class FairScheduler:
                 per_tenant[j.spec.tenant] = per_tenant.get(j.spec.tenant, 0) + 1
             return {
                 "depth": len(self._pending),
+                "retrying": len(self._delayed),
                 "max_queue": self.max_queue,
                 "max_batch": self.max_batch,
                 "admitted": self._admitted,

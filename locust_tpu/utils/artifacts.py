@@ -66,6 +66,7 @@ CONFIG_AB_KINDS = (
 BENCH_SUBDICT_KINDS = {
     "dataplane": "dataplane_bench",
     "serve": "serve_bench",
+    "recovery": "recovery_bench",
 }
 
 

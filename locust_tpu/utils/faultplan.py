@@ -89,10 +89,27 @@ SITES = {
     # "delay" = admission contention.  ctx: tenant, workload.
     "serve.admit": ("error", "delay"),
     # serve.dispatch fires as a popped batch heads for the engine:
-    # "crash"/"error" = every job in the batch fails with a structured
-    # error (never a silent wrong answer) while the daemon survives;
-    # "delay" = a straggling dispatch.  ctx: jobs (batch size).
+    # "crash"/"error" = the dispatch dies — the retry/bisection ladder
+    # (docs/SERVING.md) re-runs survivors and quarantines a poison job,
+    # every terminal failure structured (never a silent wrong answer);
+    # "delay" = a straggling dispatch.  ctx: jobs (batch size) on the
+    # batch-level fire; when no batch rule matches, one sub-fire per
+    # job adds job=<job_id> so a plan can target ONE poison job.
     "serve.dispatch": ("crash", "error", "delay"),
+    # serve.journal fires inside the write-ahead job journal's append
+    # (serve/journal.py; docs/SERVING.md): "crash" models the daemon
+    # dying mid-append — a TORN record lands on disk and the append
+    # raises (the submit is rejected structured, never acked); "corrupt"
+    # mangles the record bytes silently (replay must skip the garbage
+    # line and recover every other job).  ctx: rec (record type), job.
+    "serve.journal": ("crash", "corrupt"),
+    # backend.dispatch fires on accelerator dispatches guarded by the
+    # circuit breaker (backend.guarded_dispatch): "error" models the
+    # flapping TPU tunnel dying between probe and dispatch (CLAUDE.md,
+    # 2026-07-31) — consecutive failures trip the breaker and the run
+    # resumes on CPU from the last checkpoint; "delay" models a slow
+    # tunnel.  ctx: block, backend.
+    "backend.dispatch": ("error", "delay"),
 }
 
 _RULE_KEYS = {"site", "action", "match", "times", "after", "prob", "delay_s"}

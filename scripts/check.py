@@ -85,12 +85,22 @@ def main(argv=None) -> int:
         [sys.executable, "-c", _SERVE_SMOKE], cwd=REPO, env=env,
         timeout=300,
     ).returncode
+
+    # Crash-recovery smoke (docs/SERVING.md "Durability guarantee"): a
+    # REAL daemon process is SIGKILL'd mid-job, restarted on the same
+    # write-ahead journal, and the replayed result must be byte-identical
+    # to the one-shot CLI over the same corpus/config.  Same pinned env.
+    recovery_rc = subprocess.run(
+        [sys.executable, "-c", _RECOVERY_SMOKE], cwd=REPO, env=env,
+        timeout=300,
+    ).returncode
     print(
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
-        f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}",
+        f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
+        f"recovery smoke rc={recovery_rc}",
         file=sys.stderr,
     )
-    return rc or proc.returncode or trace_rc or serve_rc
+    return rc or proc.returncode or trace_rc or serve_rc or recovery_rc
 
 
 _TRACE_ROUNDTRIP = """
@@ -147,6 +157,75 @@ client.shutdown()
 daemon.close()
 print("[check] serve smoke ok (result-cache + warm-executable hits)",
       file=sys.stderr)
+"""
+
+
+_RECOVERY_SMOKE = """
+import os, signal, subprocess, sys, tempfile
+
+td = tempfile.mkdtemp(prefix="locust_recovery_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write(b"alpha beta gamma\\nbeta gamma delta\\n" * 8)
+cfg_flags = ["--block-lines", "8", "--line-width", "64",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "recovery-smoke"}
+
+# The oracle: the one-shot CLI over the same corpus + caps.
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", corpus_path,
+     "--backend", "cpu", "--no-timing"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+
+def spawn(env=env):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.serve", "--port", "0",
+         "--journal-dir", os.path.join(td, "journal")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    line = proc.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    return proc, (host, int(port))
+
+from locust_tpu.serve.client import ServeClient
+
+proc, addr = spawn()
+try:
+    client = ServeClient(addr, b"recovery-smoke", timeout=30.0)
+    cfgov = {"block_lines": 8, "line_width": 64, "key_width": 16,
+             "emits_per_line": 8}
+    job_id = client.submit(corpus=open(corpus_path, "rb").read(),
+                           config=cfgov, no_cache=True)["job_id"]
+    # SIGKILL right behind the ack: the job is queued-or-mid-dispatch,
+    # exactly the lost-work window the journal closes.
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+finally:
+    if proc.poll() is None:
+        proc.kill()
+proc2, addr2 = spawn()
+try:
+    c2 = ServeClient(addr2, b"recovery-smoke", timeout=30.0)
+    res = c2.wait(job_id, timeout=240.0)
+    got = b"".join(
+        k + b"\\t" + str(v).encode() + b"\\n"
+        for k, v in sorted(res["pairs"])
+    )
+    assert got == one_shot.stdout, (
+        "replayed result != one-shot CLI\\n%r\\n%r"
+        % (got[:200], one_shot.stdout[:200])
+    )
+    c2.shutdown()
+    proc2.wait(timeout=30)
+finally:
+    if proc2.poll() is None:
+        proc2.kill()
+print("[check] recovery smoke ok (SIGKILL mid-job -> replay "
+      "byte-identical to the one-shot CLI)", file=sys.stderr)
 """
 
 

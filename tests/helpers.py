@@ -28,3 +28,14 @@ def py_wordcount(lines, max_tokens_per_line=None, key_width=32):
     for line in lines:
         c.update(strtok_tokens(line, max_tokens_per_line, key_width))
     return c
+
+
+def serve_abandon(daemon):
+    """Simulate kill -9 on an in-process ServeDaemon: stop the threads
+    WITHOUT the graceful close() path (no drain, no warm flush, no
+    journal compaction) — the crash the write-ahead journal exists for.
+    One definition so the durability tests and rehearsals all model the
+    same crash."""
+    daemon._shutdown.set()
+    daemon.scheduler.stop()
+    daemon._sock.close()

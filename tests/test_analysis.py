@@ -299,6 +299,8 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/io/snapshot.py",  # hooks io.ckpt_write + io.checkpoint
         "locust_tpu/engine.py",       # hooks via finalize_snapshot call
         "locust_tpu/serve/daemon.py",  # hooks serve.admit + serve.dispatch
+        "locust_tpu/serve/journal.py",  # hooks serve.journal
+        "locust_tpu/backend.py",        # hooks backend.dispatch
         "tests/test_faults.py",
         "docs/FAULTS.md",
     ):
@@ -610,6 +612,8 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/cli.py",
         "locust_tpu/obs/attribution.py",
         "locust_tpu/serve/daemon.py",  # emits the serve.* spans/metrics
+        "locust_tpu/serve/journal.py",  # emits serve.journal_ms
+        "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
     ):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)
@@ -1183,13 +1187,81 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
     assert "does not parse" in res.new[0].message
 
 
+# ------------------------------------------------------------------- R013
+
+
+def test_r013_fires_on_unbounded_blocking_calls(tmp_path):
+    _write(tmp_path, "locust_tpu/serve/svc.py", """
+        import socket
+        import threading
+
+        def serve(sock_holder):
+            conn, _ = sock_holder.sock.accept()   # no settimeout in scope
+            return conn
+
+        def wait_all(threads, ev, fut):
+            for t in threads:
+                t.join()            # unbounded
+            ev.wait()               # unbounded
+            return fut.result()     # unbounded
+    """)
+    res = _run(tmp_path, ["R013"], ["locust_tpu"])
+    assert len(res.new) == 4
+    msgs = " | ".join(f.message for f in res.new)
+    assert ".accept()" in msgs and ".join()" in msgs
+    assert ".wait()" in msgs and ".result()" in msgs
+
+
+def test_r013_silent_on_bounded_and_trusted_forms(tmp_path):
+    _write(tmp_path, "locust_tpu/distributor/svc.py", """
+        import os
+        import socket
+
+        def recv_exact(sock, n):
+            return sock.recv(n)      # param socket: caller owns deadline
+
+        def serve(self):
+            self._sock.settimeout(0.5)
+            conn, _ = self._sock.accept()   # settimeout in scope
+            return conn
+
+        def bounded(t, ev, fut, timeout):
+            t.join(timeout=5.0)
+            ev.wait(timeout)
+            fut.result(timeout=timeout)
+            return os.path.join("a", "b") + ",".join(["x", "y"])
+    """)
+    assert not _run(tmp_path, ["R013"], ["locust_tpu"]).new
+
+
+def test_r013_ignores_files_outside_daemon_tiers(tmp_path):
+    _write(tmp_path, "locust_tpu/engine2.py", """
+        def wait_all(ev):
+            ev.wait()
+    """)
+    _write(tmp_path, "tests/test_x.py", """
+        def wait_all(ev):
+            ev.wait()
+    """)
+    assert not _run(tmp_path, ["R013"], ["locust_tpu", "tests"]).new
+
+
+def test_r013_reason_noqa_suppresses(tmp_path):
+    _write(tmp_path, "locust_tpu/serve/svc.py", """
+        def drain(ev):
+            ev.wait()  # locust: noqa[R013] deliberate forever-wait: owner kills the process
+    """)
+    res = _run(tmp_path, ["R013"], ["locust_tpu"])
+    assert not res.new and res.suppressed == 1
+
+
 # ------------------------------------------------------- registry + CLI
 
 
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
-        "R009", "R010", "R011", "R012",
+        "R009", "R010", "R011", "R012", "R013",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])

@@ -173,3 +173,95 @@ def test_eager_init_watchdog_fires_in_child():
 def test_invalid_mode():
     with pytest.raises(ValueError):
         backend.select_backend("gpu")
+
+
+# ------------------------------------------------------- circuit breaker
+#
+# The dispatch-time complement of the probe machinery above: a passing
+# probe does NOT mean the window survives (CLAUDE.md, 2026-07-31 — the
+# tunnel wedged between probe and dispatch), so consecutive dispatch
+# failures trip a breaker, the run fails over to CPU from its last
+# checkpoint, and a half-open probe readmits the TPU.  All clocked by an
+# injectable fake so every transition is deterministic.
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(threshold=3, cooldown_s=30.0):
+    clk = _Clock()
+    return backend.CircuitBreaker(
+        threshold=threshold, cooldown_s=cooldown_s, clock=clk
+    ), clk
+
+
+def test_breaker_trips_after_consecutive_failures_only():
+    br, _ = _breaker(threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # success resets the consecutive count
+    br.record_failure()
+    br.record_failure()
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    assert br.stats()["trips"] == 1
+
+
+def test_breaker_half_open_single_probe_then_close():
+    br, clk = _breaker(threshold=1, cooldown_s=10.0)
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    clk.t += 10.0
+    assert br.allow()          # the one half-open probe
+    assert br.state() == "half_open"
+    assert not br.allow()      # concurrent callers stay on the fallback
+    br.record_success()
+    assert br.state() == "closed" and br.allow()
+
+
+def test_breaker_failed_probe_reopens_for_full_cooldown():
+    br, clk = _breaker(threshold=1, cooldown_s=10.0)
+    br.record_failure()
+    clk.t += 10.0
+    assert br.allow()
+    br.record_failure()        # probe dies: back to open, new cooldown
+    assert br.state() == "open"
+    clk.t += 9.9
+    assert not br.allow()
+    clk.t += 0.2
+    assert br.allow()
+    br.record_success()
+    assert br.state() == "closed"
+    assert br.stats()["trips"] == 1  # a failed probe re-opens, not re-trips
+
+
+def test_breaker_rejects_bad_params():
+    with pytest.raises(ValueError):
+        backend.CircuitBreaker(threshold=0)
+    with pytest.raises(ValueError):
+        backend.CircuitBreaker(cooldown_s=0.0)
+
+
+def test_guarded_dispatch_accounts_success_and_failure():
+    br, _ = _breaker(threshold=2)
+    assert backend.guarded_dispatch(br, lambda: 41 + 1) == 42
+    with pytest.raises(RuntimeError):
+        backend.guarded_dispatch(br, _raise_runtime)
+    st = br.stats()
+    assert st["successes"] == 1 and st["failures"] == 1
+    assert st["state"] == "closed"  # one failure, threshold two
+
+
+def _raise_runtime():
+    raise RuntimeError("tunnel died")
+
+
+def test_cpu_fallback_device_exists_on_cpu_host():
+    dev = backend.cpu_fallback_device()
+    assert dev is not None and dev.platform == "cpu"
