@@ -86,11 +86,17 @@ def _scoped_call(tracer, fn, *args, **kw):
         return fn(*args, **kw)
 
 
-def _rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.0) -> dict:
+def rpc(node: tuple[str, int], req: dict, secret: bytes, timeout: float = 1800.0) -> dict:
+    """One request/reply against a worker on a fresh connection — the
+    control-plane primitive shared by the job driver and the serve
+    tier's warm-cache RPCs (``serve_stats``, serve/pool.py)."""
     faultplan.check_connect(node[0], node[1])
     with socket.create_connection(node, timeout=timeout) as sock:
         protocol.send_frame(sock, req, secret)
         return protocol.recv_frame(sock, secret)
+
+
+_rpc = rpc  # internal call sites predate the public name
 
 
 def _verify_chunk(obj: dict, data: bytes, node, offset: int) -> None:

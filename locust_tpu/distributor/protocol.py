@@ -73,7 +73,12 @@ def trace_stamp(shard: int | None = None) -> dict | None:
 FETCH_CHUNK = 8 * 1024 * 1024
 FETCH_CHUNK_MAX = 32 * 1024 * 1024
 
-COMMANDS = ("ping", "map", "fetch", "shutdown")
+# serve_batch/serve_stats are the serve tier's scale-out dispatch
+# surface (serve/pool.py -> worker.py): a worker started WITHOUT
+# --serve answers them with a structured error, and pre-serve workers
+# fall off the same "unknown command" path — both read as a failed
+# placement the daemon's local engine absorbs.
+COMMANDS = ("ping", "map", "fetch", "serve_batch", "serve_stats", "shutdown")
 
 # Replay window: frames older than this are rejected; nonces are remembered
 # for at least this long (worker side).

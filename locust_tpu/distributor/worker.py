@@ -88,11 +88,34 @@ class Worker:
         conn_timeout: float = 30.0,
         max_connections: int = 32,
         support_binary: bool = True,
+        serve: bool = False,
+        serve_max_engines: int = 4,
     ):
         if not secret:
             raise ValueError("worker requires a shared secret (Q8: no open RCE)")
         self.secret = secret
         self.map_runner = map_runner
+        # Scale-out serve dispatch (docs/SERVING.md): with serve=True the
+        # worker answers ``serve_batch`` — an in-process engine fold over
+        # a coalesced job batch, behind its OWN warm-executable cache
+        # (serve/cache.py), which is what makes pool cache-affinity a
+        # real scheduling input.  Lazy: the cache (and jax, at first
+        # dispatch) only enters a worker that opted in.
+        self._serve_cache = None
+        if serve:
+            from locust_tpu.serve.cache import ExecutableCache
+
+            self._serve_cache = ExecutableCache(
+                max_engines=serve_max_engines
+            )
+            # Tiny verified-corpus cache (sha -> split lines): a sharded
+            # job sends several requests referencing ONE spill, and a
+            # retried batch re-references its sha — without this every
+            # request re-reads, re-hashes and re-splits the full corpus
+            # on the dispatch critical path.  Content-addressed keys
+            # can never go stale; 2 entries bound the memory.
+            self._serve_corpus: dict[str, list] = {}
+            self._serve_corpus_lock = threading.Lock()
         # support_binary=False emulates a pre-binary (JSON-only) peer:
         # negotiation requests are ignored and every reply is a JSON
         # frame — the version-skew interop tests pin that an old worker
@@ -235,6 +258,10 @@ class Worker:
             return {"status": "ok", "bye": True}
         if cmd == "map":
             return self._traced_map(req)
+        if cmd == "serve_batch":
+            return self._serve_batch(req)
+        if cmd == "serve_stats":
+            return self._serve_stats()
         # fetch: stream back an intermediate file this worker produced, one
         # bounded window per request so arbitrarily large intermediates fit
         # the frame limit (the master pipelines ``offset`` windows until
@@ -372,6 +399,148 @@ class Worker:
                 pass
         return resp
 
+    # ------------------------------------------------- serve-batch surface
+
+    def _serve_stats(self) -> dict:
+        """The pool's warm-cache RPC (serve/pool.py seed_affinity): which
+        shapes this worker already holds compiled.  A daemon restarting
+        against a warm fleet re-learns affinity homes from this instead
+        of cold-spraying its first batches."""
+        if self._serve_cache is None:
+            return {"status": "error",
+                    "error": "serve dispatch not enabled (start with --serve)"}
+        return {
+            "status": "ok",
+            "exec_cache": self._serve_cache.stats(),
+            "warm_shapes": self._serve_cache.warm_shapes(),
+        }
+
+    def _serve_batch(self, req: dict) -> dict:
+        """Fold one coalesced serve batch on this worker's engine.
+
+        The daemon's pool (serve/pool.py) sends the batch meta plus
+        content-addressed corpus REFERENCES — ``spill_dir/<sha>.bin``
+        files the journal/pool already wrote once — and this handler
+        verifies every sha before folding, so a stale, torn, or
+        misdirected spill is a structured error, never a silent wrong
+        answer.  Shard entries carry ``line_start``/``line_end`` (the
+        same half-open line-range contract as the map command) and fold
+        just that slice.  Dispatches serialize under ``_map_lock`` (one
+        accelerator per node, same stance as map)."""
+        if self._serve_cache is None:
+            return {"status": "error",
+                    "error": "serve dispatch not enabled (start with --serve)"}
+        from locust_tpu.config import EngineConfig
+        from locust_tpu.serve import batch as batching
+        from locust_tpu.serve.jobs import (
+            SPEC_CONFIG_KEYS,
+            WORKLOADS,
+            Job,
+            JobSpec,
+        )
+
+        workload = req.get("workload")
+        if workload not in WORKLOADS:
+            return {"status": "error",
+                    "error": f"unknown workload {workload!r}"}
+        overrides = req.get("config") or {}
+        if not isinstance(overrides, dict) or (
+            set(overrides) - set(SPEC_CONFIG_KEYS)
+        ):
+            return {"status": "error",
+                    "error": f"bad config overrides {overrides!r}"}
+        try:
+            cfg = EngineConfig(**overrides)
+            bucket = int(req["bucket"])
+            spill_dir = str(req["spill_dir"])
+            jobs_meta = list(req["jobs"])
+        except (KeyError, TypeError, ValueError) as e:
+            return {"status": "error", "error": f"bad serve_batch: {e}"}
+        if not jobs_meta:
+            return {"status": "error", "error": "serve_batch with no jobs"}
+        spec = JobSpec(tenant="pool", workload=workload, cfg=cfg)
+        corpora: dict[str, list] = {}
+        jobs: list[Job] = []
+        for jm in jobs_meta:
+            try:
+                sha = str(jm["sha"])
+                job_id = str(jm["job_id"])
+                a = jm.get("line_start")
+                b = jm.get("line_end")
+            except (KeyError, TypeError):
+                return {"status": "error", "error": f"bad job entry {jm!r}"}
+            try:
+                lines = self._serve_corpus_lines(sha, spill_dir)
+            except ValueError as e:
+                return {"status": "error", "error": str(e)}
+            if a is not None or b is not None:
+                lines = lines[int(a or 0):
+                              int(b) if b is not None else len(lines)]
+            # Each (sha, slice) is its own staging key: two shards of one
+            # corpus must not alias each other's lines.
+            ckey = f"{sha}:{a}:{b}"
+            n_lines = len(lines)
+            n_blocks, jbucket = batching.job_shape(n_lines, cfg)
+            if jbucket > bucket:
+                return {"status": "error",
+                        "error": f"job {job_id}: {n_lines} lines need "
+                                 f"bucket {jbucket} > batch bucket {bucket}"}
+            corpora[ckey] = lines
+            jobs.append(Job(
+                job_id=job_id, spec=spec, corpus_digest=ckey,
+                n_lines=n_lines, n_blocks=n_blocks, bucket=bucket,
+            ))
+        njobs_padded = batching.bucket_blocks(len(jobs))
+        try:
+            with self._map_lock:  # one accelerator: folds serialize
+                engine, hit = self._serve_cache.lookup(
+                    spec, njobs_padded, bucket
+                )
+                results = batching.dispatch_batch(engine, jobs, corpora)
+                self._serve_cache.mark_compiled(spec, njobs_padded, bucket)
+                out = []
+                for job, res in zip(jobs, results):
+                    pairs = res.to_host_pairs()
+                    out.append({
+                        "job_id": job.job_id,
+                        "pairs": [
+                            [base64.b64encode(k).decode(), int(v)]
+                            for k, v in pairs
+                        ],
+                        "distinct": int(res.num_segments),
+                        "truncated": bool(res.truncated),
+                        "overflow_tokens": int(res.overflow_tokens),
+                    })
+        except Exception as e:  # noqa: BLE001 - structured, worker survives
+            return {"status": "error",
+                    "error": f"serve dispatch failed: "
+                             f"{type(e).__name__}: {e}"}
+        return {"status": "ok", "warm": bool(hit), "results": out}
+
+    def _serve_corpus_lines(self, sha: str, spill_dir: str) -> list:
+        """One spilled corpus read+verified+split, through the tiny LRU
+        cache.  Raises ``ValueError`` with the structured message on a
+        missing/damaged spill — a stale or torn spill must never fold."""
+        with self._serve_corpus_lock:
+            ent = self._serve_corpus.pop(sha, None)
+            if ent is not None:
+                self._serve_corpus[sha] = ent  # LRU touch
+                return ent
+        path = os.path.join(spill_dir, f"{sha}.bin")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise ValueError(f"corpus spill unreadable: {e}")
+        if hashlib.sha256(data).hexdigest() != sha:
+            raise ValueError(f"corpus spill {sha} fails its content hash")
+        lines = data.splitlines()
+        with self._serve_corpus_lock:
+            self._serve_corpus[sha] = lines
+            while len(self._serve_corpus) > 2:
+                self._serve_corpus.pop(next(iter(self._serve_corpus)))
+        return lines
+
     def _read_window(
         self, real: str, offset: int, max_bytes: int, files: dict | None
     ) -> tuple[bytes, int]:
@@ -405,6 +574,14 @@ def main(argv=None) -> int:
     p.add_argument("--fault-plan", default=None,
                    help="chaos-test fault plan: JSON text or a path "
                         f"(also ${faultplan.ENV_VAR}); see docs/FAULTS.md")
+    p.add_argument("--workdir", default="/tmp",
+                   help="fetch containment boundary (server-side config)")
+    p.add_argument("--serve", action="store_true",
+                   help="answer serve_batch dispatches from a serve "
+                        "daemon's worker pool (docs/SERVING.md "
+                        "scale-out dispatch); holds warm engines")
+    p.add_argument("--serve-max-engines", type=int, default=4,
+                   help="warm engines kept by the serve cache (LRU)")
     args = p.parse_args(argv)
     faultplan.install(args.fault_plan)
     secret = os.environ.get(args.secret_env, "").encode()
@@ -412,7 +589,8 @@ def main(argv=None) -> int:
         print(f"error: set ${args.secret_env} (refusing unauthenticated mode)",
               file=sys.stderr)
         return 2
-    w = Worker(args.host, args.port, secret)
+    w = Worker(args.host, args.port, secret, workdir=args.workdir,
+               serve=args.serve, serve_max_engines=args.serve_max_engines)
     print(f"[worker] listening on {w.addr[0]}:{w.addr[1]}", file=sys.stderr)
     w.serve_forever()
     return 0

@@ -35,6 +35,7 @@ NAMES = {
     "serve.queue_wait": "span",     # serve: dispatcher waiting on the queue
     "serve.compile_or_hit": "span", # serve: warm-executable cache lookup/build
     "serve.dispatch": "span",       # serve: one coalesced batch dispatch
+    "serve.place": "span",          # serve: pool placement decision (pool.py)
     "serve.demux": "span",          # serve: per-job result split + store
     # --- instant events ----------------------------------------------
     "fault.injected": "event",      # a faultplan rule fired (site, action)
@@ -64,6 +65,7 @@ NAMES = {
     "serve.exec_cache_hits": "counter",    # warm-executable cache hits
     "serve.exec_cache_misses": "counter",  # ... and compiles/builds paid
     "serve.result_cache_hits": "counter",  # result cache answered a submit
+    "serve.affinity_hits": "counter",      # pool placements on the warm worker
     "serve.journal_ms": "histogram",  # per-append journal write latency
     "backend.breaker_trips": "counter",  # closed->open breaker transitions
 }

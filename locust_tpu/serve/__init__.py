@@ -36,4 +36,5 @@ from locust_tpu.serve.jobs import (  # noqa: F401
     JobSpec,
 )
 from locust_tpu.serve.journal import JobJournal  # noqa: F401
+from locust_tpu.serve.pool import PoolDispatchError, WorkerPool  # noqa: F401
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler  # noqa: F401

@@ -2,7 +2,10 @@
 
     python -m locust_tpu.serve [--host H] [--port P] [--secret-env VAR]
         [--max-queue N] [--max-batch N] [--warm-dir DIR]
+        [--workers H:P,H:P] [--shard-min-blocks N]
         [--fault-plan PLAN] [--trace-out FILE]        # run the daemon
+        # --workers: scale-out dispatch across serve-capable distributor
+        # workers (each started with --serve); docs/SERVING.md
 
     python -m locust_tpu.serve submit FILE [--tenant T] [--weight W]
         [--block-lines N] [--sort-mode M] [--no-wait] ...   # one job
@@ -61,6 +64,16 @@ def _daemon_main(argv) -> int:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="export the daemon's serve.* telemetry as "
                         "Chrome-trace JSON at exit (docs/OBSERVABILITY.md)")
+    p.add_argument("--workers", default=None, metavar="H:P,H:P",
+                   help="scale-out dispatch: comma-separated host:port "
+                        "roster of serve-capable distributor workers "
+                        "(python -m locust_tpu.distributor.worker "
+                        "--serve); batches place across them with "
+                        "cache affinity, the local engine stays the "
+                        "floor (docs/SERVING.md)")
+    p.add_argument("--shard-min-blocks", type=int, default=64,
+                   help="blocks at which a large job fans out across "
+                        "the worker pool (with --workers)")
     args = p.parse_args(argv)
     faultplan.install(args.fault_plan)
     from locust_tpu import obs
@@ -77,6 +90,11 @@ def _daemon_main(argv) -> int:
             tenant_quota=args.tenant_quota,
             warm_dir=args.warm_dir,
             journal_dir=args.journal_dir,
+            workers=tuple(
+                w.strip() for w in (args.workers or "").split(",")
+                if w.strip()
+            ),
+            shard_min_blocks=args.shard_min_blocks,
         ),
     )
     print(f"[serve] listening on {daemon.addr[0]}:{daemon.addr[1]}",

@@ -54,13 +54,15 @@ def job_shape(n_lines: int, cfg) -> tuple[int, int]:
     return n_blocks, bucket_blocks(n_blocks)
 
 
-def stage_batch(engine, jobs: list[Job], corpora: dict[str, bytes]):
+def stage_batch(engine, jobs: list[Job], corpora: dict):
     """Build the ``[padded_jobs, bucket, block_lines, width]`` stack.
 
-    ``corpora`` maps corpus digest -> raw bytes (the daemon holds bytes
-    only while the job is in flight).  Returns the device-put stack; the
-    job axis pads to ``bucket_blocks(len(jobs))`` so batch sizes share
-    compiled shapes exactly like block counts do.
+    ``corpora`` maps corpus digest -> raw bytes OR a pre-split line list
+    (the pool worker's shard path slices lines once and stages them
+    directly — re-joining just to re-split would double the work).
+    Returns the device-put stack; the job axis pads to
+    ``bucket_blocks(len(jobs))`` so batch sizes share compiled shapes
+    exactly like block counts do.
     """
     import jax
 
@@ -70,19 +72,71 @@ def stage_batch(engine, jobs: list[Job], corpora: dict[str, bytes]):
     njobs = bucket_blocks(len(jobs))
     stack = np.zeros((njobs, bucket, bl, w), dtype=np.uint8)
     for j, job in enumerate(jobs):
-        rows = engine.rows_from_lines(
-            split_lines(corpora[job.corpus_digest])
-        )
+        data = corpora[job.corpus_digest]
+        lines = data if isinstance(data, list) else split_lines(data)
+        rows = engine.rows_from_lines(lines)
         n = rows.shape[0]
         flat = stack[j].reshape(bucket * bl, w)
+        if n > flat.shape[0]:
+            raise ValueError(
+                f"job {job.job_id}: {n} staged lines exceed the batch "
+                f"shape ({bucket} blocks x {bl} lines)"
+            )
         flat[:n] = rows[:, :w]
     return jax.device_put(stack)
 
 
-def dispatch_batch(engine, jobs: list[Job], corpora: dict[str, bytes]):
+def dispatch_batch(engine, jobs: list[Job], corpora: dict):
     """Stage + run one coalesced dispatch; returns the per-job RunResults
     (padded job slots dropped).  Pure compute — spans/accounting are the
     daemon's (serve/daemon.py keeps the obs emission sites literal)."""
     blocks = stage_batch(engine, jobs, corpora)
     results = engine.run_batch(blocks)
     return results[: len(jobs)]
+
+
+def merge_shard_results(
+    shard_results: list[dict], cfg, combine: str = "sum"
+) -> tuple[list[tuple[bytes, int]], int, bool, int]:
+    """Merge per-shard tables through the engine's own combine.
+
+    ``shard_results`` are the pool workers' per-shard replies
+    (``pairs`` as (key bytes, value) tuples plus the
+    truncated/overflow flags).  The merge is the SAME primitive the
+    hierarchical mesh and the CLI reduce stage trust — concatenate the
+    shard tables as an emit batch, ``sort_and_compact`` +
+    ``segment_reduce`` them on device, decode exactly — so a sharded
+    job's table equals the unsharded fold's table whenever the merged
+    distinct count fits the configured table (the non-truncated regime;
+    a shard CAN only see fewer distinct keys than the whole corpus, so
+    sharding never truncates more than the local fold would).
+
+    Returns ``(pairs, distinct, truncated, overflow_tokens)``.
+    """
+    import jax.numpy as jnp
+
+    from locust_tpu.core.kv import KVBatch
+    from locust_tpu.engine import finalize_host_pairs
+    from locust_tpu.ops import segment_reduce, sort_and_compact
+
+    kw = cfg.key_width
+    all_pairs = [p for res in shard_results for p in res["pairs"]]
+    overflow = sum(int(res.get("overflow_tokens", 0)) for res in shard_results)
+    shard_truncated = any(bool(res.get("truncated")) for res in shard_results)
+    if not all_pairs:
+        return [], 0, shard_truncated, overflow
+    keys = np.zeros((len(all_pairs), kw), dtype=np.uint8)
+    values = np.zeros(len(all_pairs), dtype=np.int32)
+    for i, (k, v) in enumerate(all_pairs):
+        kb = k[:kw]
+        keys[i, : len(kb)] = np.frombuffer(kb, dtype=np.uint8)
+        values[i] = v
+    batch = KVBatch.from_bytes(
+        jnp.asarray(keys), jnp.asarray(values),
+        jnp.ones(len(all_pairs), bool),
+    )
+    table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), combine)
+    pairs = finalize_host_pairs(table, combine)
+    distinct = len(pairs)
+    truncated = shard_truncated or distinct > cfg.resolved_table_size
+    return pairs, distinct, truncated, overflow

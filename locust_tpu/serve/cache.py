@@ -145,6 +145,16 @@ class ExecutableCache:
                 self._shapes.add(key)
                 self.compiles += 1
 
+    def warm_shapes(self) -> list[list]:
+        """Every compiled shape as ``[workload, cfg_fp, njobs, bucket]``
+        rows — the worker's ``serve_stats`` reply (the pool's warm-cache
+        RPC seeds its affinity map from this, serve/pool.py)."""
+        with self._lock:
+            return [
+                [key[0], key[1], njobs, bucket]
+                for (key, njobs, bucket) in sorted(self._shapes)
+            ]
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -39,11 +39,16 @@ events and latency/cache metrics, all in the closed obs registry (R009).
 
 from __future__ import annotations
 
+import base64
+import concurrent.futures
 import contextlib
 import dataclasses
 import hashlib
 import logging
+import os
+import shutil
 import socket
+import tempfile
 import threading
 import time
 import uuid
@@ -66,6 +71,7 @@ from locust_tpu.serve.jobs import (
 )
 from locust_tpu.serve.jobs import pairs_bytes as jobs_pairs_bytes
 from locust_tpu.serve.journal import JobJournal
+from locust_tpu.serve.pool import PoolDispatchError
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler
 from locust_tpu.utils import faultplan
 
@@ -117,6 +123,24 @@ class ServeConfig:
     # max_attempts; these bound how long each wait between them is.
     retry_base_s: float = 0.2
     retry_cap_s: float = 5.0
+    # Scale-out dispatch (docs/SERVING.md): place batches across a pool
+    # of serve-capable distributor workers ("host:port" roster; empty =
+    # every batch folds on the daemon's local engine, exactly the
+    # pre-pool behavior).  The local engine stays the FLOOR: a saturated
+    # or dead pool degrades to local dispatch, never to a dead daemon.
+    workers: tuple = ()
+    pool_inflight: int = 1           # concurrent batches per worker
+    pool_rpc_timeout: float = 600.0  # bound on one worker dispatch RPC
+    # Content-addressed corpus spill the pool workers read (<sha>.bin):
+    # defaults to the journal's spill dir when journaling, else a
+    # daemon-owned temp dir.  Workers must share this filesystem.
+    pool_spill_dir: str | None = None
+    # Large-job sharding: a job of >= shard_min_blocks blocks fans out
+    # over up to shard_max workers (contiguous block-aligned line
+    # ranges) and merges through the engine's combine; fewer than 2
+    # placeable workers = the whole job folds locally.
+    shard_min_blocks: int = 64
+    shard_max: int = 4
 
 
 class ServeDaemon:
@@ -165,7 +189,57 @@ class ServeDaemon:
             if self.cfg.journal_dir
             else None
         )
+        self.pool = None
+        self._pool_spill_owned: str | None = None
+        if self.cfg.workers:
+            from locust_tpu.serve.pool import WorkerPool
+
+            spill_dir = self.cfg.pool_spill_dir
+            if spill_dir is None and self.journal is not None:
+                # Share the journal's content-addressed spill: admitted
+                # corpora are already on disk there, so pool dispatches
+                # re-serialize nothing.
+                spill_dir = self.journal.corpus_dir
+            if spill_dir is None:
+                spill_dir = tempfile.mkdtemp(prefix="locust-serve-pool-")
+                self._pool_spill_owned = spill_dir
+            self.pool = WorkerPool(
+                self.cfg.workers,
+                secret,
+                spill_dir=spill_dir,
+                max_inflight=self.cfg.pool_inflight,
+                rpc_timeout=self.cfg.pool_rpc_timeout,
+                # A pool-owned dir has no journal compaction behind it:
+                # cap it so a long-running distinct-corpus stream cannot
+                # fill the disk (evicted spills re-spill on retry).
+                spill_cap_bytes=(
+                    2 * self.cfg.max_queue_bytes
+                    if self._pool_spill_owned else None
+                ),
+            )
+            # Warm-cache RPC: re-learn which worker already holds which
+            # compiled shapes (a daemon restart against a warm fleet
+            # must not cold-spray its first batches).  Best-effort.
+            for w in self.pool.workers:
+                self.pool.seed_affinity(w)
+            # Shard coordinators run OFF the dispatcher thread: a
+            # coordinator blocks (bounded) on its shard futures, and
+            # parking the single dispatcher there would stall every
+            # other tenant's dispatch and the deadline sweep for up to
+            # pool_rpc_timeout.  Dedicated and small on purpose —
+            # coordinators submit shard RPCs to the POOL executor, so
+            # sharing that executor could deadlock with every thread a
+            # waiting coordinator.
+            self._shard_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="serve-shard"
+            )
         self._lock = threading.Lock()
+        # The node has ONE accelerator (the worker daemon's _map_lock
+        # stance): every LOCAL device touch — engine folds, and the
+        # shard coordinators' merge/local-fallback paths, which run on
+        # their own executor — serializes here.  Remote RPC waits are
+        # just sockets and never take it.
+        self._engine_lock = threading.Lock()
         self._jobs: dict[str, Job] = {}       # insertion order = age
         self._corpus_bytes: dict[str, bytes] = {}  # job_id -> in-flight bytes
         self._corpus_total = 0  # sum of _corpus_bytes values (admission cap)
@@ -255,6 +329,21 @@ class ServeDaemon:
                 "serve dispatcher still busy after 90s at close; jobs "
                 "finishing after this point will not reach warm state"
             )
+        if self.pool is not None:
+            # Pool teardown ordering (docs/SERVING.md): stop placements
+            # and join inflight worker RPCs (bounded) BEFORE the
+            # stranded-job drain and the warm flush — a remote batch
+            # landing during the drain still publishes its results, and
+            # a batch that dies with its worker requeues onto the
+            # stopped scheduler, fails structured shutting_down below.
+            self.pool.close(timeout=30.0)
+            # After the pool's sockets close, any coordinator still
+            # waiting sees its shard futures fail fast and routes its
+            # job through the stopped scheduler to a structured
+            # shutting_down — nothing left is worth blocking on.
+            self._shard_executor.shutdown(wait=False, cancel_futures=True)
+            if self._pool_spill_owned:
+                shutil.rmtree(self._pool_spill_owned, ignore_errors=True)
         # The stopped scheduler answers next_batch with None forever, so
         # jobs still queued here can never dispatch: fail them with the
         # structured shutdown code and free their buffered corpora
@@ -590,8 +679,6 @@ class ServeDaemon:
         return {"status": "ok", **job.public()}
 
     def _cmd_result(self, req: dict) -> dict:
-        import base64
-
         job = self._job(req)
         if job is None:
             return structured_error(
@@ -693,6 +780,7 @@ class ServeDaemon:
             "queued_corpus_bytes": corpus_total,
             "history_result_bytes": result_bytes,
             "queue": self.scheduler.stats(),
+            "pool": self.pool.stats() if self.pool is not None else None,
             "exec_cache": self.executables.stats(),
             "result_cache": self.results.stats(),
             "warm": self.warm.stats() if self.warm is not None else None,
@@ -707,9 +795,29 @@ class ServeDaemon:
         # bisect_group keeps the halves of a failed batch from
         # re-coalescing (jobs.Job.bisect_group): None for never-failed
         # jobs, so the common path batches exactly as before.
-        return (
+        key = (
             self.executables.engine_key(job.spec), job.bucket,
             job.bisect_group,
+        )
+        if self.pool is not None and self._shardable(job):
+            # Shard-eligible jobs dispatch solo: the fan-out owns the
+            # whole batch, so nothing may coalesce with it.
+            return key + (("solo", job.job_id),)
+        # Cache affinity deliberately does NOT ride the key: the warm
+        # set is itself keyed by (engine_key, bucket) — components
+        # already in the key — so appending it could never change which
+        # jobs coalesce; placement happens per-BATCH in pool.place(),
+        # where the affinity decision actually lives.
+        return key
+
+    def _affinity_key(self, job: Job) -> tuple:
+        return (self.executables.engine_key(job.spec), job.bucket)
+
+    def _shardable(self, job: Job) -> bool:
+        return (
+            self.pool is not None
+            and self.cfg.shard_max >= 2
+            and job.n_blocks >= self.cfg.shard_min_blocks
         )
 
     def _dispatch_loop(self) -> None:
@@ -747,15 +855,92 @@ class ServeDaemon:
             if self.scheduler.depth()
             else contextlib.nullcontext()
         )
+        # One batch per free placement slot, plus the local floor:
+        # independent same-tick batches overlap across the pool instead
+        # of serializing on one engine (the scale-out tentpole).  With
+        # no pool this is exactly the old single-batch pop.
+        limit = 1 + (self.pool.free_slots() if self.pool is not None else 0)
         with cm:
-            jobs = self.scheduler.next_batch(
-                self._batch_key, timeout=self.cfg.dispatch_poll_s
+            batches = self.scheduler.next_batches(
+                self._batch_key, max_batches=limit,
+                timeout=self.cfg.dispatch_poll_s,
             )
-        if not jobs:
+        if not batches:
             return
+        local: list[tuple[list[Job], dict]] = []
+        for jobs in batches:
+            jobs, corpora = self._pop_batch_corpora(jobs)
+            if not jobs:
+                continue
+            # Chaos: the dispatch boundary (docs/FAULTS.md).  "crash"
+            # models the dispatch dying mid-flight, "error" an
+            # engine-side failure: either way the batch enters the
+            # retry/bisection ladder — every TERMINAL failure is a
+            # STRUCTURED error (never a silent wrong answer) and the
+            # daemon lives on.  When no batch-level rule matches, one
+            # sub-fire per job carries job=<id> so a plan can target ONE
+            # poison job (the bisection tests ride this).
+            rule = faultplan.fire("serve.dispatch", jobs=len(jobs))
+            if rule is None:
+                for j in jobs:
+                    rule = faultplan.fire(
+                        "serve.dispatch", jobs=len(jobs), job=j.job_id
+                    )
+                    if rule is not None:
+                        break
+            if rule is not None:
+                if rule.action == "delay":
+                    time.sleep(rule.delay_s)
+                else:
+                    self._retry_or_fail(
+                        jobs, corpora,
+                        f"[faultplan] injected dispatch {rule.action}",
+                    )
+                    continue
+            if len(jobs) == 1 and self._shardable(jobs[0]):
+                # On the dedicated coordinator executor: the coordinator
+                # blocks (bounded) on its shard futures and must not
+                # park the dispatcher; the shard RPCs themselves overlap
+                # on the pool executor.
+                try:
+                    self._shard_executor.submit(
+                        self._dispatch_sharded, jobs[0], corpora
+                    )
+                except RuntimeError:  # executor shut down under us
+                    self._fail_batch(jobs, structured_error(
+                        "shutting_down",
+                        "daemon shut down before this job was "
+                        "dispatched; resubmit after it returns",
+                    ))
+                continue
+            worker = (
+                self.pool.place(self._affinity_key(jobs[0]))
+                if self.pool is not None else None
+            )
+            if worker is not None:
+                try:
+                    self.pool.submit(
+                        self._dispatch_remote, worker, jobs, corpora
+                    )
+                except RuntimeError:  # pool closed between place/submit
+                    self.pool.release(worker)
+                    local.append((jobs, corpora))
+            else:
+                local.append((jobs, corpora))
+        for jobs, corpora in local:
+            self._dispatch_local(jobs, corpora)
+        self._maybe_mark_warm()
+        if self.journal is not None and self.journal.compact_due():
+            self._compact_journal()
+
+    def _pop_batch_corpora(
+        self, jobs: list[Job]
+    ) -> tuple[list[Job], dict]:
+        """Flip a popped batch to running and collect its buffered
+        corpora; jobs whose bytes vanished fail structured."""
         now = time.monotonic()
         with self._lock:
-            corpora = {}
+            corpora: dict = {}
             lost = []
             for j in jobs:
                 j.state = "running"
@@ -779,147 +964,361 @@ class ServeDaemon:
                 "bug) — resubmit",
             ))
             jobs = [j for j in jobs if j not in lost]
-            if not jobs:
-                return
-        # Chaos: the dispatch boundary (docs/FAULTS.md).  "crash" models
-        # the dispatch dying mid-flight, "error" an engine-side failure:
-        # either way the batch enters the retry/bisection ladder — every
-        # TERMINAL failure is a STRUCTURED error (never a silent wrong
-        # answer) and the daemon lives on.  When no batch-level rule
-        # matches, one sub-fire per job carries job=<id> so a plan can
-        # target ONE poison job (the bisection tests ride this).
-        rule = faultplan.fire("serve.dispatch", jobs=len(jobs))
-        if rule is None:
-            for j in jobs:
+        return jobs, corpora
+
+    def _dispatch_local(self, jobs: list[Job], corpora: dict) -> None:
+        """One batch on the daemon's own engine — the pre-pool path and
+        the pool's permanent floor."""
+        spec = jobs[0].spec
+        njobs_padded = batching.bucket_blocks(len(jobs))
+        bucket = jobs[0].bucket
+        for j in jobs:
+            j.placed_on = "local"
+        try:
+            # One accelerator (the worker daemon's _map_lock stance):
+            # the whole device region — compile-or-build, the fold, and
+            # the demux device->host transfers — holds the engine lock,
+            # so the dispatcher and the shard coordinators' local
+            # fallback/merge paths never overlap device work.
+            with self._engine_lock:
+                with obs.span(
+                    "serve.compile_or_hit",
+                    jobs=len(jobs), bucket=bucket,
+                ):
+                    engine, hit = self.executables.lookup(
+                        spec, njobs_padded, bucket
+                    )
+                # Literal names per branch: the R009 convention — the
+                # analyzer (and registry) must see every emission site.
+                if hit:
+                    obs.metric_inc("serve.exec_cache_hits")
+                else:
+                    obs.metric_inc("serve.exec_cache_misses")
+                with obs.span(
+                    "serve.dispatch", jobs=len(jobs), bucket=bucket
+                ):
+                    results = batching.dispatch_batch(
+                        engine, jobs, corpora
+                    )
+                self.executables.mark_compiled(spec, njobs_padded, bucket)
+                # Demux stays INSIDE the failure boundary:
+                # to_host_pairs() is the device->host transfer and can
+                # raise (the flapping TPU tunnel is the documented
+                # case) — an escape here would leave jobs "running"
+                # forever, a hang where the tier promises a structured
+                # error.  _fail_batch skips the jobs already marked
+                # done, so a mid-demux failure keeps the finished
+                # results and fails only the rest.
+                with obs.span("serve.demux", jobs=len(jobs)):
+                    done = time.monotonic()
+                    for job, res in zip(jobs, results):
+                        pairs = res.to_host_pairs()
+                        self._finish_job(
+                            job, pairs, res.num_segments, res.truncated,
+                            res.overflow_tokens,
+                            "warm" if hit else "cold", done,
+                        )
+        except Exception as e:  # noqa: BLE001 - jobs retry/fail, daemon survives
+            logger.exception("serve dispatch failed")
+            self._retry_or_fail(jobs, corpora, f"{type(e).__name__}: {e}")
+
+    def _dispatch_remote(
+        self, worker, jobs: list[Job], corpora: dict
+    ) -> None:
+        """One batch on one pool worker (runs on the pool executor).
+
+        Any failure — the worker dying mid-batch, a structured worker
+        error, an injected fault — feeds the jobs back through the SAME
+        retry/bisection ladder as a local failure: the pool quarantines
+        the worker (WorkerHealth backoff) and the retry lands on a
+        survivor or the local floor, so a worker death costs latency,
+        never an answer.
+        """
+        try:
+            try:
+                # Worker-scoped chaos fire: a plan matching worker=<name>
+                # models THIS worker dying mid-serve-batch.
                 rule = faultplan.fire(
-                    "serve.dispatch", jobs=len(jobs), job=j.job_id
+                    "serve.dispatch", jobs=len(jobs), worker=worker.name
                 )
                 if rule is not None:
-                    break
+                    if rule.action == "delay":
+                        time.sleep(rule.delay_s)
+                    else:
+                        raise PoolDispatchError(
+                            f"[faultplan] injected dispatch {rule.action} "
+                            f"on worker {worker.name}"
+                        )
+                bucket = jobs[0].bucket
+                for j in jobs:
+                    j.placed_on = worker.name
+                req_jobs = [
+                    {"job_id": j.job_id, "sha": j.corpus_digest,
+                     "n_lines": j.n_lines}
+                    for j in jobs
+                ]
+                with obs.span(
+                    "serve.dispatch",
+                    jobs=len(jobs), bucket=bucket, worker=worker.name,
+                ):
+                    reply = self.pool.dispatch(
+                        worker, jobs[0].spec.workload,
+                        jobs[0].config_overrides or {}, bucket,
+                        req_jobs, corpora,
+                    )
+                self.pool.mark_warm(worker, self._affinity_key(jobs[0]))
+                hit = bool(reply.get("warm"))
+                results = reply["results"]
+                with obs.span("serve.demux", jobs=len(jobs)):
+                    done = time.monotonic()
+                    for job, res in zip(jobs, results):
+                        pairs = [
+                            (base64.b64decode(k), int(v))
+                            for k, v in res["pairs"]
+                        ]
+                        self._finish_job(
+                            job, pairs, int(res["distinct"]),
+                            bool(res["truncated"]),
+                            int(res["overflow_tokens"]),
+                            "warm" if hit else "cold", done,
+                        )
+            except Exception as e:  # noqa: BLE001 - retry ladder absorbs it
+                logger.warning(
+                    "serve pool dispatch on %s failed: %s: %s",
+                    worker.name, type(e).__name__, e,
+                )
+                self._retry_or_fail(
+                    jobs, corpora,
+                    f"pool worker {worker.name}: {type(e).__name__}: {e}",
+                )
+        finally:
+            self.pool.release(worker)
+        self._maybe_mark_warm()
+
+    def _dispatch_sharded(self, job: Job, corpora: dict) -> None:
+        """Fan one large job across the pool and merge through the
+        engine's combine (docs/SERVING.md "Scale-out dispatch").
+
+        The corpus moves ONCE through the content-addressed spill; each
+        worker folds a contiguous block-aligned line range and the
+        partial tables merge with the same sort+segment-reduce the
+        hierarchical mesh trusts — byte-identical to the local fold in
+        the non-truncated regime.  Fewer than 2 placeable workers (or
+        any shard failing) degrades to the local floor / retry ladder.
+        """
+        from locust_tpu.serve import pool as pool_mod
+
+        cfg = job.spec.cfg
+        corpus = corpora.get(job.corpus_digest, b"")
+        ranges = pool_mod.shard_ranges(
+            job.n_lines, cfg.block_lines, self.cfg.shard_max
+        )
+        placements = []
+        submitted: list = []
+        used: set[int] = set()
+        try:
+            if len(ranges) >= 2:
+                shard_blocks = -(-(ranges[0][1] - ranges[0][0])
+                                 // cfg.block_lines)
+                akey = (
+                    self.executables.engine_key(job.spec),
+                    batching.bucket_blocks(shard_blocks),
+                )
+                for _ in ranges:
+                    w = self.pool.place(akey, exclude=used)
+                    if w is None:
+                        break
+                    used.add(w.idx)
+                    placements.append(w)
+            if len(placements) < 2:
+                for w in placements:
+                    self.pool.release(w)
+                placements = []
+                self._dispatch_local([job], corpora)
+                return
+            if len(placements) < len(ranges):
+                ranges = pool_mod.shard_ranges(
+                    job.n_lines, cfg.block_lines, len(placements)
+                )
+                for w in placements[len(ranges):]:
+                    self.pool.release(w)
+                placements = placements[: len(ranges)]
+            job.shards = len(ranges)
+            job.placed_on = "shard:" + ",".join(
+                w.name for w in placements
+            )
+            self.pool.spill(job.corpus_digest, corpus)
+            futs = []
+            for (a, b), w in zip(ranges, placements):
+                fut = self.pool.submit(self._run_shard_rpc, w, job, a, b)
+                # The slot release rides the FUTURE, not the
+                # coordinator: on a wait timeout the RPC is still
+                # holding the worker's dispatch lane, and an early
+                # release would let place() queue a second batch behind
+                # the stuck connection.
+                fut.add_done_callback(
+                    lambda _f, _w=w: self.pool.release(_w)
+                )
+                submitted.append(w)
+                futs.append(fut)
+            done_f, not_done = concurrent.futures.wait(
+                futs, timeout=self.cfg.pool_rpc_timeout + 30.0
+            )
+            if not_done:
+                raise PoolDispatchError(
+                    f"{len(not_done)} shard dispatch(es) still inflight "
+                    f"after {self.cfg.pool_rpc_timeout + 30.0:.0f}s"
+                )
+            shard_results = [f.result(timeout=1.0) for f in futs]
+            combine = WORKLOADS[job.spec.workload][1]
+            # The merge is device work on the coordinator thread: it
+            # serializes with every other local device touch.
+            with self._engine_lock:
+                pairs, distinct, truncated, overflow = (
+                    batching.merge_shard_results(
+                        shard_results, cfg, combine
+                    )
+                )
+            self._finish_job(
+                job, pairs, distinct, truncated, overflow, "shard",
+                time.monotonic(),
+            )
+        except Exception as e:  # noqa: BLE001 - retry ladder absorbs it
+            logger.warning(
+                "sharded dispatch of %s failed: %s: %s",
+                job.job_id, type(e).__name__, e,
+            )
+            self._retry_or_fail(
+                [job], corpora,
+                f"sharded dispatch: {type(e).__name__}: {e}",
+            )
+        finally:
+            # Only reservations that never became a shard RPC release
+            # here — submitted ones release via their future's callback
+            # (which runs even when the coordinator timed out on them).
+            for w in placements:
+                if w not in submitted:
+                    self.pool.release(w)
+
+    def _run_shard_rpc(self, worker, job: Job, a: int, b: int) -> dict:
+        """One shard of a fanned-out job on one worker (pool executor).
+        Returns the decoded shard table; raises on any failure — the
+        coordinator fails the whole job into the retry ladder."""
+        from locust_tpu.serve import pool as pool_mod
+
+        cfg = job.spec.cfg
+        shard_id = pool_mod.stable_shard_id(job.job_id, a, b)
+        sbucket = batching.bucket_blocks(-(-(b - a) // cfg.block_lines))
+        rule = faultplan.fire(
+            "serve.dispatch", jobs=1, worker=worker.name, job=shard_id
+        )
         if rule is not None:
             if rule.action == "delay":
                 time.sleep(rule.delay_s)
             else:
-                self._retry_or_fail(
-                    jobs, corpora,
-                    f"[faultplan] injected dispatch {rule.action}",
+                raise PoolDispatchError(
+                    f"[faultplan] injected shard {rule.action} on "
+                    f"worker {worker.name}"
                 )
-                return
-        spec = jobs[0].spec
-        njobs_padded = batching.bucket_blocks(len(jobs))
-        bucket = jobs[0].bucket
-        try:
-            with obs.span(
-                "serve.compile_or_hit",
-                jobs=len(jobs), bucket=bucket,
-            ):
-                engine, hit = self.executables.lookup(
-                    spec, njobs_padded, bucket
+        with obs.span(
+            "serve.dispatch", jobs=1, bucket=sbucket, worker=worker.name,
+        ):
+            reply = self.pool.dispatch(
+                worker, job.spec.workload, job.config_overrides or {},
+                sbucket,
+                [{"job_id": shard_id, "sha": job.corpus_digest,
+                  "n_lines": b - a, "line_start": a, "line_end": b}],
+                {},  # corpus already spilled by the coordinator
+            )
+        self.pool.mark_warm(
+            worker, (self.executables.engine_key(job.spec), sbucket)
+        )
+        res = reply["results"][0]
+        return {
+            "pairs": [
+                (base64.b64decode(k), int(v)) for k, v in res["pairs"]
+            ],
+            "distinct": int(res["distinct"]),
+            "truncated": bool(res["truncated"]),
+            "overflow_tokens": int(res["overflow_tokens"]),
+        }
+
+    def _finish_job(
+        self, job: Job, pairs: list, distinct, truncated, overflow,
+        cache_label: str, done: float,
+    ) -> None:
+        """Publish one finished job — the demux core shared by the
+        local, remote, and shard paths."""
+        size = jobs_pairs_bytes(pairs)
+        meta = {
+            "distinct": int(distinct),
+            "truncated": bool(truncated),
+            "overflow_tokens": int(overflow),
+        }
+        if job.expired(done):
+            # Deadline expiry ANYWHERE answers structured
+            # deadline_exceeded — even when the result just landed: the
+            # client stopped waiting at the budget it set.  The correct
+            # result still feeds the result cache below, so a resubmit
+            # of the same work is answered instantly.
+            self._fail_jobs([(job, structured_error(
+                "deadline_exceeded",
+                f"deadline of {job.spec.deadline_s}s expired "
+                "while the job was running; the result was "
+                "cached — resubmit to fetch it",
+            ))])
+            if not job.spec.no_cache:
+                self.results.put(
+                    job.corpus_digest, job.spec.fingerprint(), pairs,
+                    meta=meta,
                 )
-            # Literal names per branch: the R009 convention — the
-            # analyzer (and the registry) must see every emission site.
-            if hit:
-                obs.metric_inc("serve.exec_cache_hits")
-            else:
-                obs.metric_inc("serve.exec_cache_misses")
-            with obs.span("serve.dispatch", jobs=len(jobs), bucket=bucket):
-                results = batching.dispatch_batch(engine, jobs, corpora)
-            self.executables.mark_compiled(spec, njobs_padded, bucket)
-            # Demux stays INSIDE the failure boundary: to_host_pairs()
-            # is the device->host transfer and can raise (the flapping
-            # TPU tunnel is the documented case) — an escape here would
-            # leave jobs "running" forever, a hang where the tier
-            # promises a structured error.  _fail_batch skips the jobs
-            # already marked done, so a mid-demux failure keeps the
-            # finished results and fails only the rest.
-            with obs.span("serve.demux", jobs=len(jobs)):
-                done = time.monotonic()
-                for job, res in zip(jobs, results):
-                    pairs = res.to_host_pairs()
-                    size = jobs_pairs_bytes(pairs)
-                    if job.expired(done):
-                        # Deadline expiry ANYWHERE answers structured
-                        # deadline_exceeded — even when the result just
-                        # landed: the client stopped waiting at the
-                        # budget it set.  The correct result still feeds
-                        # the result cache below, so a resubmit of the
-                        # same work is answered instantly.
-                        self._fail_jobs([(job, structured_error(
-                            "deadline_exceeded",
-                            f"deadline of {job.spec.deadline_s}s expired "
-                            "while the job was running; the result was "
-                            "cached — resubmit to fetch it",
-                        ))])
-                        if not job.spec.no_cache:
-                            self.results.put(
-                                job.corpus_digest, job.spec.fingerprint(),
-                                pairs,
-                                meta={
-                                    "distinct": res.num_segments,
-                                    "truncated": bool(res.truncated),
-                                    "overflow_tokens": int(
-                                        res.overflow_tokens
-                                    ),
-                                },
-                            )
-                        continue
-                    with self._lock:
-                        # state flips to "done" LAST: status/result
-                        # handlers read job fields without this lock, so
-                        # the state write is the publish barrier — a
-                        # reader seeing "done" must also see the result
-                        # (done-with-None-result would answer an empty
-                        # pairs list as success).
-                        job.cache = "warm" if hit else "cold"
-                        job.finished_s = done
-                        job.result = pairs
-                        job.result_bytes = size
-                        job.distinct = res.num_segments
-                        job.truncated = bool(res.truncated)
-                        job.overflow_tokens = int(res.overflow_tokens)
-                        job.state = "done"
-                        self._completed += 1
-                        self._result_bytes += size
-                        self._evict_history(keep=job.job_id)
-                    if not job.spec.no_cache:
-                        self.results.put(
-                            job.corpus_digest, job.spec.fingerprint(), pairs,
-                            meta={
-                                "distinct": job.distinct,
-                                "truncated": job.truncated,
-                                "overflow_tokens": job.overflow_tokens,
-                            },
-                        )
-                    if self.journal is not None:
-                        self.journal.append_state(job.job_id, "done")
-                    obs.metric_inc("serve.jobs")
-                    obs.metric_observe("serve.latency_ms", job.latency_ms())
-        except Exception as e:  # noqa: BLE001 - jobs retry/fail, daemon survives
-            logger.exception("serve dispatch failed")
-            self._retry_or_fail(jobs, corpora, f"{type(e).__name__}: {e}")
             return
-        if self.warm is not None:
-            # Latest-wins background generation: the dispatcher never
-            # blocks on disk (io/snapshot.py).  Distance-based cadence,
-            # not modulo: ``completed`` advances by batch size here and
-            # by result-cache hits on handler threads, so the dispatcher
-            # may never OBSERVE a multiple of warm_every — a modulo
-            # check could skip marks forever and silently demote the
-            # cadence to "clean shutdown only".  The cursor read+write
-            # holds the lock (close() snapshots the generation counter
-            # under it); the mark itself stays outside — it only enqueues
-            # on the async writer.  ``completed`` is re-read here, not
-            # carried from the demux loop: a batch whose every job
-            # deadline-expired at demux completes nothing.
-            with self._lock:
-                completed = self._completed
-                due = completed - self._warm_marked >= self.cfg.warm_every
-                if due:
-                    self._warm_marked = completed
+        with self._lock:
+            # state flips to "done" LAST: status/result handlers read
+            # job fields without this lock, so the state write is the
+            # publish barrier — a reader seeing "done" must also see the
+            # result (done-with-None-result would answer an empty pairs
+            # list as success).
+            job.cache = cache_label
+            job.finished_s = done
+            job.result = pairs
+            job.result_bytes = size
+            job.distinct = int(distinct)
+            job.truncated = bool(truncated)
+            job.overflow_tokens = int(overflow)
+            job.state = "done"
+            self._completed += 1
+            self._result_bytes += size
+            self._evict_history(keep=job.job_id)
+        if not job.spec.no_cache:
+            self.results.put(
+                job.corpus_digest, job.spec.fingerprint(), pairs,
+                meta=meta,
+            )
+        if self.journal is not None:
+            self.journal.append_state(job.job_id, "done")
+        obs.metric_inc("serve.jobs")
+        obs.metric_observe("serve.latency_ms", job.latency_ms())
+
+    def _maybe_mark_warm(self) -> None:
+        """Latest-wins background warm generation: never blocks on disk
+        (io/snapshot.py).  Distance-based cadence, not modulo:
+        ``completed`` advances by batch size on three dispatch paths and
+        by result-cache hits on handler threads, so no single thread may
+        ever OBSERVE a multiple of warm_every — a modulo check could
+        skip marks forever and silently demote the cadence to "clean
+        shutdown only".  The cursor read+write holds the lock (close()
+        snapshots the generation counter under it); the mark itself
+        stays outside — it only enqueues on the async writer."""
+        if self.warm is None:
+            return
+        with self._lock:
+            completed = self._completed
+            due = completed - self._warm_marked >= self.cfg.warm_every
             if due:
-                self.warm.mark(completed)
-        if self.journal is not None and self.journal.compact_due():
-            self._compact_journal()
+                self._warm_marked = completed
+        if due:
+            self.warm.mark(completed)
 
     # ---------------------------------------------------- retry/fail/journal
 

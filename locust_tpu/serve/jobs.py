@@ -256,6 +256,11 @@ class Job:
     # Raw submit ``config`` overrides, kept for the write-ahead journal:
     # replay rebuilds the EngineConfig from exactly what the client sent.
     config_overrides: dict | None = None
+    # Scale-out placement (docs/SERVING.md "Scale-out dispatch"): where
+    # the LAST dispatch ran — "local", a pool worker's "host:port", or
+    # "shard" for a fanned-out large job; None until first dispatch.
+    placed_on: str | None = None
+    shards: int | None = None  # shard count for a fanned-out large job
 
     def deadline_mono(self) -> float | None:
         """Absolute monotonic deadline, or None.  Anchored at submit
@@ -295,6 +300,8 @@ class Job:
             "queue_ms": self.queue_ms(),
             "latency_ms": self.latency_ms(),
             "batch_size": self.batch_size,
+            "placed_on": self.placed_on,
+            "shards": self.shards,
             "attempts": self.attempts,
             "max_attempts": self.spec.max_attempts,
             "deadline_s": self.spec.deadline_s,

@@ -144,6 +144,14 @@ class JobJournal:
         self._spills = 0
         self._spill_ms = 0.0
 
+    @property
+    def corpus_dir(self) -> str:
+        """The content-addressed spill directory.  The serve worker
+        pool shares it (serve/pool.py): admitted corpora are already
+        spilled here once, so a pool dispatch ships a reference, not
+        bytes."""
+        return self._corpus_dir
+
     # ------------------------------------------------------------- appends
 
     def append_admit(self, job, corpus: bytes) -> None:

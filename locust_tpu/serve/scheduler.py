@@ -201,6 +201,28 @@ class FairScheduler:
         = shares one compiled dispatch).  The head job is the fair pick;
         followers join in fair order only if their key matches.
         """
+        batches = self.next_batches(batch_key, max_batches=1,
+                                    timeout=timeout)
+        return batches[0] if batches else None
+
+    def next_batches(
+        self, batch_key, max_batches: int = 1,
+        timeout: float | None = None,
+    ) -> list[list[Job]] | None:
+        """Pop up to ``max_batches`` DISJOINT fair batches in one lock
+        acquisition; None on timeout or shutdown (never ``[]``).
+
+        The scale-out dispatcher's entry point (docs/SERVING.md): with a
+        worker pool beneath it, the daemon asks for as many batches as
+        it has free placement slots, so independent same-tick batches
+        overlap across workers instead of serializing on one engine.
+        Fairness is unchanged — each batch is picked exactly as
+        ``next_batch`` would have picked it after the previous one's
+        virtual-time charge, so the multi-batch pop equals N sequential
+        single pops, minus the lock churn.
+        """
+        if max_batches < 1:
+            raise ValueError("max_batches must be >= 1")
         with self._cond:
             self._promote_ripe()
             while (not self._pending or self._paused) and not self._stopped:
@@ -213,28 +235,32 @@ class FairScheduler:
                 # the dispatcher with a bounded join; a cold TPU compile
                 # here would blow it and race the warm-state flush).
                 return None
-            ordered = self._fair_order()
-            head = ordered[0]
-            key = batch_key(head)
-            batch = [head]
-            for j in ordered[1:]:
-                if len(batch) >= self.max_batch:
-                    break
-                if batch_key(j) == key:
-                    batch.append(j)
-            for j in batch:
-                self._pending.remove(j)
-                w = max(j.spec.weight, 1e-6)
-                self._vt[j.spec.tenant] = (
-                    self._vt.get(j.spec.tenant, 0.0) + j.bucket / w
+            batches: list[list[Job]] = []
+            while self._pending and len(batches) < max_batches:
+                ordered = self._fair_order()
+                head = ordered[0]
+                key = batch_key(head)
+                batch = [head]
+                for j in ordered[1:]:
+                    if len(batch) >= self.max_batch:
+                        break
+                    if batch_key(j) == key:
+                        batch.append(j)
+                for j in batch:
+                    self._pending.remove(j)
+                    w = max(j.spec.weight, 1e-6)
+                    self._vt[j.spec.tenant] = (
+                        self._vt.get(j.spec.tenant, 0.0) + j.bucket / w
+                    )
+                # The head was the most-behind tenant, so its charged vt
+                # is the service time the system has actually reached
+                # (within one stride) — the monotone clock idle joiners
+                # floor at.
+                self._global_vt = max(
+                    self._global_vt, self._vt.get(head.spec.tenant, 0.0)
                 )
-            # The head was the most-behind tenant, so its charged vt is
-            # the service time the system has actually reached (within
-            # one stride) — the monotone clock idle joiners floor at.
-            self._global_vt = max(
-                self._global_vt, self._vt.get(head.spec.tenant, 0.0)
-            )
-            self._dispatched += len(batch)
+                self._dispatched += len(batch)
+                batches.append(batch)
             # Prune idle tenants whose vt is at/below the floor: their
             # rejoin would re-enter at the floor anyway, so the entry
             # carries no information — and tenant names are CLIENT
@@ -252,7 +278,7 @@ class FairScheduler:
                 if t not in pending_tenants and v <= self._global_vt
             ]:
                 del self._vt[t]
-            return batch
+            return batches
 
     # ------------------------------------------------------------ control
 

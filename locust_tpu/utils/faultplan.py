@@ -96,6 +96,12 @@ SITES = {
     # batch-level fire; when no batch rule matches, one sub-fire per
     # job adds job=<job_id> so a plan can target ONE poison job.
     "serve.dispatch": ("crash", "error", "delay"),
+    # serve.place fires inside the worker pool's placement decision
+    # (serve/pool.py WorkerPool.place): "error" = placement fails and
+    # the batch falls back to the daemon's LOCAL engine — the result
+    # stays byte-identical, the pool survives; "delay" = a slow
+    # placement decision.  ctx: key (affinity key).
+    "serve.place": ("error", "delay"),
     # serve.journal fires inside the write-ahead job journal's append
     # (serve/journal.py; docs/SERVING.md): "crash" models the daemon
     # dying mid-append — a TORN record lands on disk and the append

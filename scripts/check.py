@@ -94,13 +94,24 @@ def main(argv=None) -> int:
         [sys.executable, "-c", _RECOVERY_SMOKE], cwd=REPO, env=env,
         timeout=300,
     ).returncode
+
+    # Scale-out pool smoke (docs/SERVING.md "Scale-out dispatch"): a
+    # daemon over TWO real worker processes serves a submit exactly,
+    # then one worker is SIGKILL'd mid-serve-batch and the retried
+    # result must STILL be byte-identical to the one-shot CLI — worker
+    # death costs latency, never an answer.  Same pinned env.
+    pool_rc = subprocess.run(
+        [sys.executable, "-c", _POOL_SMOKE], cwd=REPO, env=env,
+        timeout=420,
+    ).returncode
     print(
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
         f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
-        f"recovery smoke rc={recovery_rc}",
+        f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}",
         file=sys.stderr,
     )
-    return rc or proc.returncode or trace_rc or serve_rc or recovery_rc
+    return (rc or proc.returncode or trace_rc or serve_rc
+            or recovery_rc or pool_rc)
 
 
 _TRACE_ROUNDTRIP = """
@@ -226,6 +237,109 @@ finally:
         proc2.kill()
 print("[check] recovery smoke ok (SIGKILL mid-job -> replay "
       "byte-identical to the one-shot CLI)", file=sys.stderr)
+"""
+
+
+_POOL_SMOKE = """
+import json, os, signal, subprocess, sys, tempfile, time
+
+td = tempfile.mkdtemp(prefix="locust_pool_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write(b"alpha beta gamma\\nbeta gamma delta\\n" * 8)
+cfg_flags = ["--block-lines", "8", "--line-width", "64",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "pool-smoke"}
+
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", corpus_path,
+     "--backend", "cpu", "--no-timing"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+
+def spawn_worker():
+    # Workers hold their SECOND serve_batch 3s (rpc.delay, after: 1) so
+    # the SIGKILL below provably lands MID-serve-batch: the first job
+    # dispatches clean and warms the worker, the same-bucket repeat is
+    # routed back to it by affinity and held inside the dispatch.
+    wenv = dict(env, LOCUST_FAULT_PLAN=json.dumps({"seed": 7, "rules": [
+        {"site": "rpc.delay", "action": "delay", "delay_s": 3.0,
+         "match": {"cmd": "serve_batch"}, "after": 1, "times": 1}]}))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.distributor.worker",
+         "--serve", "--port", "0"],
+        env=wenv, stderr=subprocess.PIPE, text=True,
+    )
+    line = proc.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    return proc, f"{host}:{port}"
+
+w1, a1 = spawn_worker()
+w2, a2 = spawn_worker()
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "locust_tpu.serve", "--port", "0",
+     "--workers", f"{a1},{a2}"],
+    env=env, stderr=subprocess.PIPE, text=True,
+)
+try:
+    line = daemon.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    from locust_tpu.serve.client import ServeClient
+    client = ServeClient((host, int(port)), b"pool-smoke", timeout=60.0)
+    cfgov = {"block_lines": 8, "line_width": 64, "key_width": 16,
+             "emits_per_line": 8}
+    corpus = open(corpus_path, "rb").read()
+
+    def as_cli(pairs):
+        return b"".join(
+            k + b"\\t" + str(v).encode() + b"\\n" for k, v in sorted(pairs)
+        )
+
+    jid = client.submit(corpus=corpus, config=cfgov,
+                        no_cache=True)["job_id"]
+    res = client.wait(jid, timeout=240.0)
+    assert as_cli(res["pairs"]) == one_shot.stdout, "pool != one-shot CLI"
+    placed = client.status(jid)["placed_on"]
+    victim = w1 if placed == a1 else w2
+    survivor_addr = a2 if placed == a1 else a1
+
+    # Same-SHAPE repeat (same line count -> same bucket): affinity sends
+    # it to the warm worker, whose serve_batch is held 3s by the fault
+    # rule — SIGKILL it mid-batch.
+    corpus2 = corpus.replace(b"alpha", b"omega")
+    j2 = client.submit(corpus=corpus2, config=cfgov,
+                       no_cache=True)["job_id"]
+    time.sleep(0.8)
+    victim.send_signal(signal.SIGKILL)
+    victim.wait(timeout=10)
+    res2 = client.wait(j2, timeout=240.0)
+    p2 = os.path.join(td, "corpus2.txt")
+    with open(p2, "wb") as f:
+        f.write(corpus2)
+    oracle2 = subprocess.run(
+        [sys.executable, "-m", "locust_tpu", p2,
+         "--backend", "cpu", "--no-timing"] + cfg_flags,
+        env=env, capture_output=True, timeout=240,
+    )
+    assert oracle2.returncode == 0, oracle2.stderr[-800:]
+    assert as_cli(res2["pairs"]) == oracle2.stdout, (
+        "post-worker-death result != one-shot CLI"
+    )
+    st2 = client.status(j2)
+    assert st2["placed_on"] != placed, st2
+    client.shutdown()
+    daemon.wait(timeout=60)
+finally:
+    for p in (w1, w2, daemon):
+        if p.poll() is None:
+            p.kill()
+print("[check] pool smoke ok (2 real workers; SIGKILL mid-serve-batch "
+      "-> retried result byte-identical to the one-shot CLI)",
+      file=sys.stderr)
 """
 
 

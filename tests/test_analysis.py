@@ -300,6 +300,7 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/engine.py",       # hooks via finalize_snapshot call
         "locust_tpu/serve/daemon.py",  # hooks serve.admit + serve.dispatch
         "locust_tpu/serve/journal.py",  # hooks serve.journal
+        "locust_tpu/serve/pool.py",     # hooks serve.place
         "locust_tpu/backend.py",        # hooks backend.dispatch
         "tests/test_faults.py",
         "docs/FAULTS.md",
@@ -613,6 +614,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/obs/attribution.py",
         "locust_tpu/serve/daemon.py",  # emits the serve.* spans/metrics
         "locust_tpu/serve/journal.py",  # emits serve.journal_ms
+        "locust_tpu/serve/pool.py",     # emits serve.place/affinity_hits
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
     ):
         dst = tmp_path / rel

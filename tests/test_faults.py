@@ -1289,3 +1289,158 @@ def test_chaos_backend_dispatch_delay_absorbed(tmp_path):
     assert time.monotonic() - t0 >= 0.2
     assert p.rules[0].fired == 1
     assert br.state() == "closed" and br.stats()["trips"] == 0
+
+
+# ------------------------------------------- scale-out serve pool (ISSUE 11)
+#
+# With a worker pool beneath the dispatcher (serve/pool.py), the same
+# guarantee must hold: a placement failure (serve.place), an injected
+# dispatch kill on one worker (serve.dispatch with worker ctx), or a
+# REAL worker death mid-serve-batch all end in a byte-identical result
+# (local floor / surviving worker via the retry ladder) or a structured
+# error — never a silent wrong answer, never a dead daemon.
+
+
+def _serve_pool_rig(n_workers=2, **cfg_kw):
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+
+    workers = []
+    for _ in range(n_workers):
+        w = Worker(secret=SECRET, serve=True)
+        w.serve_in_thread()
+        workers.append(w)
+    cfg = ServeConfig(
+        max_queue=8, max_batch=2, dispatch_poll_s=0.02, retry_base_s=0.02,
+        workers=tuple(f"127.0.0.1:{w.addr[1]}" for w in workers),
+        **cfg_kw,
+    )
+    daemon = ServeDaemon(secret=SECRET, cfg=cfg)
+    daemon.serve_in_thread()
+    return daemon, workers, ServeClient(daemon.addr, SECRET, timeout=30.0)
+
+
+def test_chaos_serve_place_error_falls_back_to_local_exact():
+    """serve.place error: the placement decision fails, the batch runs
+    on the daemon's LOCAL engine instead — the result is byte-identical
+    to a pool placement (the floor is a full engine, not a degraded
+    one), and the pool keeps serving afterwards."""
+    daemon, workers, client = _serve_pool_rig()
+    try:
+        p = plan([{"site": "serve.place", "action": "error", "times": 1}])
+        with faultplan.active_plan(p):
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            res = client.wait(ack["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+        assert p.rules[0].fired == 1
+        st = client.status(ack["job_id"])
+        assert st["placed_on"] == "local"
+        assert client.stats()["pool"]["local_fallbacks"] >= 1
+        # The spent rule leaves the pool healthy: the next job places.
+        ack2 = client.submit(
+            corpus=SERVE_CORPUS + b"extra tail line\n", config=SERVE_CFG,
+            no_cache=True,
+        )
+        res2 = client.wait(ack2["job_id"], timeout=60.0)
+        assert client.status(ack2["job_id"])["placed_on"] != "local"
+        assert res2["state"] == "done"
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_serve_place_delay_only_slows_placement():
+    """serve.place delay: a slow placement decision delays the dispatch,
+    nothing else changes — the result stays exact."""
+    daemon, workers, client = _serve_pool_rig()
+    try:
+        p = plan([{"site": "serve.place", "action": "delay",
+                   "delay_s": 0.3, "times": 1}])
+        with faultplan.active_plan(p):
+            t0 = time.monotonic()
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            res = client.wait(ack["job_id"], timeout=60.0)
+            assert time.monotonic() - t0 >= 0.3
+        assert dict(res["pairs"]) == _serve_oracle()
+        assert p.rules[0].fired == 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_serve_dispatch_worker_kill_retries_exact():
+    """serve.dispatch with worker ctx: a plan targeting ONE worker's
+    dispatches models that worker dying mid-serve-batch.  The retry
+    ladder re-places the batch (rule spent / other worker / local
+    floor) and the SAME submit still lands the exact result."""
+    daemon, workers, client = _serve_pool_rig()
+    try:
+        name = f"127.0.0.1:{workers[0].addr[1]}"
+        p = plan([{"site": "serve.dispatch", "action": "crash",
+                   "match": {"worker": name}, "times": 1}])
+        with faultplan.active_plan(p):
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            res = client.wait(ack["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+        assert p.rules[0].fired == 1
+        st = client.status(ack["job_id"])
+        assert st["state"] == "done" and st["attempts"] >= 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_serve_pool_worker_death_mid_batch_recovers_exact():
+    """REAL worker death mid-serve-batch: the worker is held inside the
+    dispatch by an rpc.delay rule while its connection is cut and its
+    accept loop shut down — the daemon sees the peer die mid-frame,
+    quarantines it (WorkerHealth backoff), and the retry lands the
+    byte-identical result on the survivor or the local floor."""
+    daemon, workers, client = _serve_pool_rig()
+    try:
+        victim = daemon.pool.workers[0]
+        p = plan([{"site": "rpc.delay", "action": "delay", "delay_s": 1.0,
+                   "match": {"cmd": "serve_batch"}, "times": 1}])
+        with faultplan.active_plan(p):
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG, no_cache=True
+            )
+            # Wait until the dispatch RPC is IN FLIGHT on the victim
+            # (the rpc.delay rule holds the worker for 1s and the RPC
+            # holds the connection lock for its duration), then kill it
+            # for real: accept loop down + the established socket cut
+            # mid-frame.  The socket is closed WITHOUT taking the lock —
+            # the inflight RPC owns it, and close() is exactly what cuts
+            # its pending recv (taking the lock would mean politely
+            # waiting for the dispatch we are trying to kill).
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if victim._conn_lock.locked():
+                    break
+                time.sleep(0.02)
+            assert victim._conn_lock.locked(), "dispatch never reached the victim"
+            workers[0]._shutdown.set()
+            workers[0]._sock.close()
+            conn = victim._conn
+            if conn is not None:
+                conn.close()
+            res = client.wait(ack["job_id"], timeout=60.0)
+        assert dict(res["pairs"]) == _serve_oracle()
+        st = client.status(ack["job_id"])
+        assert st["state"] == "done" and st["attempts"] >= 1
+        pool_stats = client.stats()["pool"]
+        assert pool_stats["dispatch_failures"] >= 1
+        # The survivor (or the local floor) answered: never the victim.
+        assert st["placed_on"] != victim.name
+    finally:
+        daemon.close()
+        for w in workers[1:]:
+            _shutdown(w)

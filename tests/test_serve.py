@@ -1341,3 +1341,302 @@ def test_cancelled_job_replays_cancelled_code_across_restart(tmp_path):
         assert c2.status(jid)["state"] == "cancelled"
     finally:
         d2.close()
+
+
+# ----------------------------------------- scale-out worker pool (ISSUE 11)
+#
+# The placement layer (serve/pool.py) + the distributor worker's
+# serve_batch surface: placement units, the loopback multi-worker
+# battery (byte-identical to single-worker), the cache-affinity and
+# spill-over pins, and large-job sharding through the engine's combine.
+
+
+def _pool_rig(n_workers=2, **cfg_kw):
+    from locust_tpu.distributor.worker import Worker
+
+    ws = []
+    for _ in range(n_workers):
+        w = Worker(secret=SECRET, serve=True)
+        w.serve_in_thread()
+        ws.append(w)
+    cfg = ServeConfig(
+        max_queue=16, max_batch=4, dispatch_poll_s=0.02, retry_base_s=0.02,
+        workers=tuple(f"127.0.0.1:{w.addr[1]}" for w in ws),
+        **cfg_kw,
+    )
+    daemon = ServeDaemon(secret=SECRET, cfg=cfg)
+    daemon.serve_in_thread()
+    return daemon, ws, ServeClient(daemon.addr, SECRET, timeout=60.0)
+
+
+def _stop_workers(ws):
+    for w in ws:
+        w._shutdown.set()
+        try:
+            w._sock.close()
+        except OSError:
+            pass
+
+
+def _pool_oracle(corpus: bytes) -> dict:
+    return dict(py_wordcount(corpus.splitlines(),
+                             max_tokens_per_line=8, key_width=16))
+
+
+def test_worker_pool_place_affinity_spillover_units(tmp_path):
+    from locust_tpu.serve.pool import WorkerPool
+
+    pool = WorkerPool(("h1:1", "h2:2"), SECRET,
+                      spill_dir=str(tmp_path / "sp"))
+    key = (("wordcount", "fp"), 1)
+    w = pool.place(key)
+    assert w is not None and w.idx == 0  # least-loaded, ties by index
+    pool.mark_warm(w, key)
+    pool.release(w)
+    w2 = pool.place(key)
+    assert w2.idx == 0  # affinity: the warm worker wins
+    # Affine worker saturated (slot held): spill-over to least-loaded.
+    w3 = pool.place(key)
+    assert w3.idx == 1
+    # Everyone saturated: None = the local-engine floor.
+    assert pool.place(key) is None
+    st = pool.stats()
+    assert st["affinity_hits"] == 1
+    assert st["spill_overs"] == 1
+    assert st["local_fallbacks"] == 1
+    # exclude: the shard fan-out never double-places one worker.
+    pool.release(w2)
+    pool.release(w3)
+    assert pool.place(key, exclude={0}).idx == 1
+    pool.close(timeout=1.0)
+    assert pool.place(key) is None  # closed pools never place
+
+
+def test_worker_pool_rejects_bad_addr_and_empty(tmp_path):
+    from locust_tpu.serve.pool import WorkerPool, parse_worker_addr
+
+    with pytest.raises(ValueError):
+        parse_worker_addr("no-port-here")
+    with pytest.raises(ValueError):
+        WorkerPool((), SECRET, spill_dir=str(tmp_path / "sp"))
+    assert parse_worker_addr("127.0.0.1:80") == ("127.0.0.1", 80)
+    assert parse_worker_addr(("h", 9)) == ("h", 9)
+
+
+def test_shard_ranges_cover_align_and_are_stable():
+    from locust_tpu.serve.pool import shard_ranges, stable_shard_id
+
+    for n_lines in (1, 7, 8, 9, 63, 64, 65, 257):
+        for shards in (1, 2, 3, 4):
+            rs = shard_ranges(n_lines, 8, shards)
+            assert rs[0][0] == 0 and rs[-1][1] == n_lines
+            assert len(rs) <= shards
+            for (a, b), (a2, _b2) in zip(rs, rs[1:]):
+                assert b == a2
+            for a, b in rs:
+                assert a % 8 == 0 and b > a
+    assert stable_shard_id("j", 0, 8) == stable_shard_id("j", 0, 8)
+    assert stable_shard_id("j", 0, 8) != stable_shard_id("j", 8, 16)
+
+
+def test_next_batches_pops_disjoint_batches_in_fair_order():
+    s = FairScheduler(max_queue=16, max_batch=2)
+    a1, a2 = mk_job("a"), mk_job("a")
+    b1, b2 = mk_job("b"), mk_job("b")
+    for j in (a1, a2, b1, b2):
+        s.admit(j)
+    batches = s.next_batches(const_key, max_batches=2, timeout=0.1)
+    # Tenant "a" is first (vt tie broken by name) and coalesces its two
+    # jobs; the SECOND batch is picked after "a" was charged, so it is
+    # tenant "b"'s — exactly two sequential next_batch picks.
+    assert [j.job_id for j in batches[0]] == [a1.job_id, a2.job_id]
+    assert [j.job_id for j in batches[1]] == [b1.job_id, b2.job_id]
+    assert s.stats()["dispatched"] == 4
+    assert s.next_batches(const_key, max_batches=2, timeout=0.05) is None
+
+
+def test_worker_serve_batch_requires_opt_in():
+    from locust_tpu.distributor.worker import Worker
+
+    w = Worker(secret=SECRET)  # no serve=True
+    assert w._handle({"cmd": "serve_stats"})["status"] == "error"
+    assert "not enabled" in w._handle({"cmd": "serve_batch"})["error"]
+
+
+def test_pool_mixed_tenant_stream_byte_identical_to_single_worker():
+    corpora = [
+        (f"w{i} alpha beta\ngamma w{i} delta\n" * 4).encode()
+        for i in range(8)
+    ]
+    big = b"".join(
+        f"t{i % 29} common x{i % 7}\n".encode() for i in range(80)
+    )
+
+    def run(client):
+        ids = [
+            client.submit(corpus=c, config=CFG_OVR,
+                          tenant=f"t{i % 3}")["job_id"]
+            for i, c in enumerate(corpora)
+        ]
+        out = []
+        for j in ids:
+            r = client.wait(j, timeout=120.0)
+            out.append((r["pairs"], r["distinct"], r["truncated"],
+                        r["overflow_tokens"]))
+        # The big job goes out over a DRAINED pool so its shard fan-out
+        # deterministically finds both workers placeable (under load it
+        # may legitimately fall back to fewer shards or local).
+        big_id = client.submit(corpus=big, config=CFG_OVR, tenant="big",
+                               weight=2.0)["job_id"]
+        r = client.wait(big_id, timeout=120.0)
+        out.append((r["pairs"], r["distinct"], r["truncated"],
+                    r["overflow_tokens"]))
+        return out, big_id
+
+    daemon, ws, client = _pool_rig(shard_min_blocks=4, shard_max=2)
+    try:
+        pooled, big_id = run(client)
+        big_st = client.status(big_id)
+        pool_stats = client.stats()["pool"]
+    finally:
+        daemon.close()
+        _stop_workers(ws)
+    single = ServeDaemon(
+        secret=SECRET,
+        cfg=ServeConfig(max_queue=16, max_batch=4, dispatch_poll_s=0.02),
+    )
+    single.serve_in_thread()
+    c2 = ServeClient(single.addr, SECRET, timeout=60.0)
+    try:
+        local, _ = run(c2)
+    finally:
+        single.close()
+    # Byte-identical across the pool, AND exact against the host oracle.
+    assert pooled == local
+    for (pairs, _d, _t, _o), c in zip(pooled, corpora + [big]):
+        assert dict(pairs) == _pool_oracle(c)
+    # The pool actually served (placements happened) and the large job
+    # fanned out across both workers.
+    assert sum(pool_stats["placements"]) > 0
+    assert big_st["shards"] == 2 and big_st["placed_on"].startswith("shard:")
+
+
+def test_pool_affinity_repeat_jobs_land_warm_compiles_unchanged():
+    from locust_tpu.distributor.master import rpc
+
+    daemon, ws, client = _pool_rig()
+    try:
+        wave1 = [(f"one{i} aa bb\ncc dd e{i}\n" * 3).encode()
+                 for i in range(4)]
+        for c in wave1:  # drained one at a time: deterministic placement
+            client.wait(client.submit(corpus=c, config=CFG_OVR)["job_id"],
+                        timeout=120.0)
+        def worker_stats():
+            return [
+                rpc(("127.0.0.1", w.addr[1]), {"cmd": "serve_stats"},
+                    SECRET, timeout=10.0)
+                for w in ws
+            ]
+        compiles1 = [s["exec_cache"]["compiles"] for s in worker_stats()]
+        hits_before = client.stats()["pool"]["affinity_hits"]
+        warm_idx = max(range(len(ws)), key=lambda i: compiles1[i])
+        warm_name = f"127.0.0.1:{ws[warm_idx].addr[1]}"
+        # NEW corpora, same shape bucket: every one must land on the
+        # warm worker (affinity pin) without a single fresh compile.
+        wave2 = [(f"two{i} qq rr\nss tt u{i}\n" * 3).encode()
+                 for i in range(4)]
+        for c in wave2:
+            jid = client.submit(corpus=c, config=CFG_OVR)["job_id"]
+            res = client.wait(jid, timeout=120.0)
+            st = client.status(jid)
+            assert st["placed_on"] == warm_name
+            assert res["cache"] == "warm"
+            assert dict(res["pairs"]) == _pool_oracle(c)
+        compiles2 = [s["exec_cache"]["compiles"] for s in worker_stats()]
+        assert sum(compiles2) == sum(compiles1), (compiles1, compiles2)
+        assert client.stats()["pool"]["affinity_hits"] > hits_before
+    finally:
+        daemon.close()
+        _stop_workers(ws)
+
+
+def test_pool_spillover_saturated_affine_worker_doesnt_block():
+    daemon, ws, client = _pool_rig()
+    try:
+        warm = (b"warm aa bb\ncc dd ee\n" * 3)
+        jid = client.submit(corpus=warm, config=CFG_OVR)["job_id"]
+        client.wait(jid, timeout=120.0)
+        warm_name = client.status(jid)["placed_on"]
+        victim = next(
+            w for w in daemon.pool.workers if w.name == warm_name
+        )
+        # Saturate the affine worker (its slot held as if mid-dispatch):
+        # the next same-bucket job must SPILL to the other worker, not
+        # queue behind the busy one.
+        with daemon.pool._lock:
+            daemon.pool._inflight[victim.idx] = daemon.pool.max_inflight
+        try:
+            c2 = b"spill ff gg\nhh ii jj\n" * 3
+            j2 = client.submit(corpus=c2, config=CFG_OVR)["job_id"]
+            res = client.wait(j2, timeout=120.0)
+            st = client.status(j2)
+            assert dict(res["pairs"]) == _pool_oracle(c2)
+            assert st["placed_on"] not in (warm_name, "local")
+            assert client.stats()["pool"]["spill_overs"] >= 1
+        finally:
+            with daemon.pool._lock:
+                daemon.pool._inflight[victim.idx] = 0
+    finally:
+        daemon.close()
+        _stop_workers(ws)
+
+
+def test_pool_seed_affinity_survives_daemon_restart():
+    from locust_tpu.distributor.worker import Worker
+
+    w = Worker(secret=SECRET, serve=True)
+    w.serve_in_thread()
+    addr = (f"127.0.0.1:{w.addr[1]}",)
+    corpus = b"seed aa bb\ncc dd ee\n" * 3
+    d1 = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        dispatch_poll_s=0.02, workers=addr))
+    d1.serve_in_thread()
+    c1 = ServeClient(d1.addr, SECRET, timeout=60.0)
+    try:
+        c1.wait(c1.submit(corpus=corpus, config=CFG_OVR)["job_id"],
+                timeout=120.0)
+    finally:
+        d1.close()
+    # A NEW daemon against the still-warm worker re-learns its affinity
+    # home from the serve_stats warm-cache RPC at startup.
+    d2 = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        dispatch_poll_s=0.02, workers=addr))
+    c2 = ServeClient(d2.addr, SECRET, timeout=60.0)
+    d2.serve_in_thread()
+    try:
+        spec = JobSpec(tenant="x", workload="wordcount", cfg=CFG)
+        key = (ExecutableCache.engine_key(spec), 1)
+        assert d2.pool.preferred(key) == (addr[0],)
+        jid = c2.submit(corpus=corpus + b"more ff\n",
+                        config=CFG_OVR)["job_id"]
+        res = c2.wait(jid, timeout=120.0)
+        assert res["cache"] == "warm"  # the worker's executable was warm
+        assert d2.pool.stats()["affinity_hits"] >= 1
+    finally:
+        d2.close()
+        _stop_workers([w])
+
+
+def test_pool_close_stops_placements_and_executor():
+    daemon, ws, client = _pool_rig()
+    try:
+        jid = client.submit(corpus=b"close aa bb\n" * 3,
+                            config=CFG_OVR)["job_id"]
+        client.wait(jid, timeout=120.0)
+    finally:
+        daemon._shutdown.set()
+        daemon.close()
+        _stop_workers(ws)
+    assert daemon.pool.place((("wordcount", "fp"), 1)) is None
+    with pytest.raises(RuntimeError):
+        daemon.pool.submit(lambda: None)
