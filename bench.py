@@ -1065,6 +1065,119 @@ def _recovery_stats() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _plan_stats() -> dict:
+    """Plan-layer overhead summary for the one-line JSON (docs/PLAN.md):
+    the plan-compiled WordCount and tf-idf pipelines against their
+    hand-wired drivers over the same corpus, best-of-3 each after a
+    shared warmup.  The compiler only NAMES work the engine already does
+    (the fused fold IS the same engine call), so the acceptance bound is
+    <= +5% — anything past that means the lowering grew a real stage.
+    Identity is asserted, not assumed: the plan run's pairs must equal
+    the hand-wired run's exactly.  Guarded like the siblings: a failure
+    never costs the headline line; ``LOCUST_BENCH_PLAN=0`` skips.
+    Completed runs land a ``plan_bench`` evidence row
+    (artifacts.BENCH_SUBDICT_KINDS)."""
+    if os.environ.get("LOCUST_BENCH_PLAN", "1") == "0":
+        return {"skipped": True}
+    try:
+        import numpy as np
+
+        from locust_tpu.apps.tfidf import build_tfidf
+        from locust_tpu.config import EngineConfig
+        from locust_tpu.engine import MapReduceEngine
+        from locust_tpu.io.corpus import synthetic_corpus
+        from locust_tpu.plan import tfidf_plan, wordcount_plan
+        from locust_tpu.plan.compile import compile_plan
+        from locust_tpu.utils import artifacts
+
+        # block_lines sizes the tf fold's pair capacity too
+        # (default_pairs_capacity = 2x emits_per_block): 2048 x 12
+        # leaves headroom over this corpus's ~31k distinct (word, doc)
+        # pairs — the tf fold RAISES on overflow, it never truncates.
+        cfg = EngineConfig(block_lines=2048, key_width=16,
+                           emits_per_line=12)
+        lines = synthetic_corpus(2 << 20, n_vocab=4000, seed=11)
+        eng = MapReduceEngine(cfg)
+        rows = eng.rows_from_lines(lines)
+        wc = compile_plan(wordcount_plan(), cfg)
+
+        def best_of(fn, n=3):
+            best, out = float("inf"), None
+            for _ in range(n):
+                t0 = time.perf_counter()
+                out = fn()
+                best = min(best, time.perf_counter() - t0)
+            return best, out
+
+        eng.run_fused(rows)  # shared warmup: compile once
+        # Both sides fold AND host-finalize: the plan run's value IS the
+        # decoded pair table, so the hand-wired side must pay the same
+        # to_host_pairs or the comparison charges the plan for work the
+        # driver also does at print time.
+        hand_s, hand_pairs = best_of(
+            lambda: eng.run_fused(rows).to_host_pairs()
+        )
+        plan_s, plan_res = best_of(
+            lambda: wc.run(rows, render=False)
+        )
+        ident = plan_res.value == hand_pairs
+
+        # tf-idf over a 4k-line slice: the pair table must FIT the
+        # default capacity (the fold raises on overflow rather than
+        # truncate), and the wall comparison only needs a real fold.
+        trows = rows[:4000]
+        ids = (np.arange(trows.shape[0]) // 8).astype(np.int32)
+        tp = compile_plan(tfidf_plan(8), cfg)
+        build_tfidf(trows, ids, cfg)  # warmup
+        tf_hand_s, tf_hand = best_of(
+            lambda: build_tfidf(trows, ids, cfg), n=2
+        )
+        tf_plan_s, tf_plan = best_of(
+            lambda: tp.run(trows, render=False), n=2
+        )
+        tf_ident = tf_plan.value == tf_hand
+        # Identity is ASSERTED, not just recorded: a lowering drift must
+        # surface as this sub-dict's error field, never as a passing
+        # bench row with identical:false buried in it.
+        assert ident and tf_ident, (
+            "plan-compiled output diverged from the hand-wired fold "
+            f"(wordcount identical={ident}, tfidf identical={tf_ident})"
+        )
+
+        def pct(plan, hand):
+            return round(100 * (plan - hand) / hand, 2)
+
+        out = {
+            "corpus_mb": round(sum(len(x) + 1 for x in lines) / 1e6, 2),
+            "wordcount_hand_s": round(hand_s, 3),
+            "wordcount_plan_s": round(plan_s, 3),
+            "wordcount_overhead_pct": pct(plan_s, hand_s),
+            "tfidf_hand_s": round(tf_hand_s, 3),
+            "tfidf_plan_s": round(tf_plan_s, 3),
+            "tfidf_overhead_pct": pct(tf_plan_s, tf_hand_s),
+            "identical": bool(ident and tf_ident),
+            "accept_5pct": bool(
+                pct(plan_s, hand_s) <= 5.0
+                and pct(tf_plan_s, tf_hand_s) <= 5.0
+            ),
+            "wordcount_fp": wordcount_plan().fingerprint(),
+            "tfidf_fp": tfidf_plan(8).fingerprint(),
+        }
+        print(
+            f"[bench] plan: wordcount {hand_s:.2f}s hand vs "
+            f"{plan_s:.2f}s plan ({out['wordcount_overhead_pct']:+.1f}%), "
+            f"tfidf {tf_hand_s:.2f}s vs {tf_plan_s:.2f}s "
+            f"({out['tfidf_overhead_pct']:+.1f}%), identical={ident and tf_ident}",
+            file=sys.stderr,
+        )
+        artifacts.record(
+            artifacts.BENCH_SUBDICT_KINDS["plan"], dict(out)
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 - the headline line comes first
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _bench_subdict_producers() -> dict:
     """Guarded sub-bench producers, two-sided against the evidence-ledger
     kinds (artifacts.BENCH_SUBDICT_KINDS, same identity discipline as
@@ -1079,6 +1192,7 @@ def _bench_subdict_producers() -> dict:
         "dataplane": _dataplane_stats,
         "serve": _serve_stats,
         "recovery": _recovery_stats,
+        "plan": _plan_stats,
     }
     if tuple(subdicts) != tuple(BENCH_SUBDICT_KINDS):
         raise RuntimeError(
@@ -1248,6 +1362,7 @@ def run_bench(backend: str) -> dict:
         "stream": _stream_stats(eng, rows),
         "serve": subdicts["serve"](),
         "recovery": subdicts["recovery"](),
+        "plan": subdicts["plan"](),
     }
     if obs_on:
         from locust_tpu import obs

@@ -1,0 +1,209 @@
+"""R014 — plan node-kind registry drift (two-sided, the R004/R011 mold).
+
+The plan layer's whole extensibility story is ONE closed registry
+(``locust_tpu/plan/nodes.py`` ``NODE_KINDS``): every dataflow node a
+plan may use is an entry there, validation rejects anything else, and
+``plan/compile.py`` must lower every entry (docs/PLAN.md).  ROADMAP
+item 4's operators land as NEW KINDS in that registry — this rule keeps
+both sides honest as they do:
+
+  * every node-kind literal CONSTRUCTED or MATCHED under ``locust_tpu/``
+    must be a registry entry — a typo'd kind at a construction site is a
+    plan nothing can validate, and a matcher arm for an unregistered
+    kind is dead code lying about coverage.  Recognized spellings (the
+    convention ``plan/nodes.py`` establishes): ``node(id, "kind", ...)``
+    / ``Node(kind="kind", ...)`` calls anywhere, and ``<expr>.kind ==
+    "kind"`` / ``<expr>.kind in ("a", "b")`` comparisons inside
+    ``locust_tpu/plan/`` (attribution discipline, like R005's
+    int-in-wire-layer rule: ``.kind`` is a common attribute name —
+    e.g. the analyzer's own thread summaries — so the comparison form
+    only binds where the plan convention lives);
+  * every registry entry must be LOWERED in ``plan/compile.py`` (its
+    literal appears there), exercised under ``tests/`` (quoted), and
+    documented in ``docs/PLAN.md`` (backticked) — a kind the compiler
+    cannot lower is a validation-passes/dispatch-explodes trap, and an
+    untested or undocumented kind is an unanchored contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from locust_tpu.analysis.core import Finding, Rule, call_name
+
+PLAN_NODES_REL = "locust_tpu/plan/nodes.py"
+PLAN_COMPILE_REL = "locust_tpu/plan/compile.py"
+PLAN_DOCS_REL = "docs/PLAN.md"
+
+_CTOR_NAMES = {"node", "Node"}
+
+
+def _parse_kinds(files, root, rel):
+    """The NODE_KINDS tuple literal: {kind: line} (None when absent)."""
+    from locust_tpu.analysis.core import parse_registry_module
+
+    tree = parse_registry_module(files, root, rel)
+    if tree is None:
+        return None
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "NODE_KINDS"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            kinds = {}
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    kinds[elt.value] = elt.lineno
+            return kinds
+    return None
+
+
+def _ctor_kind(call: ast.Call) -> str | None:
+    """The kind literal of a ``node("id", "kind", ...)`` /
+    ``Node(kind="kind", ...)`` construction, or None."""
+    leaf = call_name(call).split(".")[-1]
+    if leaf not in _CTOR_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "kind" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) >= 2:
+        arg = call.args[1]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+def _match_kinds(node: ast.Compare):
+    """Kind literals of a ``<expr>.kind == "lit"`` / ``!=`` /
+    ``in ("a", "b")`` comparison (empty list otherwise)."""
+    left = node.left
+    if not (isinstance(left, ast.Attribute) and left.attr == "kind"):
+        return []
+    if len(node.ops) != 1:
+        return []
+    cmp = node.comparators[0]
+    if isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+        if isinstance(cmp, ast.Constant) and isinstance(cmp.value, str):
+            return [cmp.value]
+    elif isinstance(node.ops[0], (ast.In, ast.NotIn)):
+        if isinstance(cmp, (ast.Tuple, ast.List, ast.Set)):
+            return [
+                e.value for e in cmp.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return []
+
+
+class PlanRegistryRule(Rule):
+    rule_id = "R014"
+    title = "plan NODE_KINDS registry drift"
+
+    # Overridable for fixture trees in tests (the R004/R011 pattern).
+    nodes_rel = PLAN_NODES_REL
+    compile_rel = PLAN_COMPILE_REL
+    docs_rel = PLAN_DOCS_REL
+    analyzer_tests_rel = "tests/test_analysis.py"
+
+    def check_project(self, files, root):
+        kinds = _parse_kinds(files, root, self.nodes_rel)
+        if kinds is None:
+            yield Finding(
+                self.rule_id, self.nodes_rel, 1, 0,
+                "cannot parse the NODE_KINDS registry (module missing or "
+                "no module-level `NODE_KINDS = (...)` tuple literal)",
+            )
+            return
+
+        plan_prefix = os.path.dirname(self.nodes_rel) + "/"
+
+        # Side 1: every constructed/matched kind literal is registered.
+        compile_literals: set[str] = set()
+        for sf in files:
+            in_locust = sf.rel.split("/", 1)[0] == "locust_tpu" or \
+                sf.rel.startswith(plan_prefix)
+            if sf.rel == self.compile_rel:
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        compile_literals.add(node.value)
+            if not in_locust or sf.rel == self.nodes_rel:
+                # The registry module defines the kinds; re-reporting
+                # its own literals would flag the registry itself.
+                continue
+            for node in ast.walk(sf.tree):
+                found = []
+                if isinstance(node, ast.Call):
+                    k = _ctor_kind(node)
+                    if k is not None:
+                        found = [k]
+                elif isinstance(node, ast.Compare) and sf.rel.startswith(
+                    plan_prefix
+                ):
+                    found = _match_kinds(node)
+                for k in found:
+                    if k not in kinds:
+                        yield Finding(
+                            self.rule_id, sf.rel, node.lineno,
+                            node.col_offset,
+                            f"plan node kind {k!r} is not in "
+                            f"NODE_KINDS ({self.nodes_rel}) — a typo'd "
+                            "kind is a plan nothing can validate",
+                        )
+
+        def read(rel):
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                return None
+
+        docs_text = read(self.docs_rel)
+        # The analyzer's OWN suite is excluded from the exercised-scan:
+        # its R014 fixtures quote phantom kinds ("window", ...) to test
+        # the RULE, and counting those as coverage would let a real
+        # future kind with that name pass the untested check forever.
+        tests_text = "\n".join(
+            sf.text for sf in files
+            if sf.rel.split("/", 1)[0] == "tests"
+            and sf.rel != self.analyzer_tests_rel
+        )
+        if docs_text is None:
+            yield Finding(
+                self.rule_id, self.docs_rel, 1, 0,
+                f"plan docs {self.docs_rel} missing — NODE_KINDS entries "
+                "cannot be verified as documented",
+            )
+
+        # Side 2: every registered kind is lowered, exercised, documented.
+        for kind, line in sorted(kinds.items()):
+            if kind not in compile_literals:
+                yield Finding(
+                    self.rule_id, self.nodes_rel, line, 0,
+                    f"NODE_KINDS entry {kind!r} is never lowered in "
+                    f"{self.compile_rel} — a kind validation admits but "
+                    "the compiler cannot execute is a dispatch-time trap",
+                )
+            if f'"{kind}"' not in tests_text:
+                yield Finding(
+                    self.rule_id, self.nodes_rel, line, 0,
+                    f"NODE_KINDS entry {kind!r} is never exercised under "
+                    "tests/ — an untested node kind is an untested "
+                    "dataflow contract",
+                )
+            if docs_text is not None and f"`{kind}`" not in docs_text:
+                yield Finding(
+                    self.rule_id, self.nodes_rel, line, 0,
+                    f"NODE_KINDS entry {kind!r} is undocumented in "
+                    f"{self.docs_rel} (backtick the kind in the node "
+                    "catalog)",
+                )

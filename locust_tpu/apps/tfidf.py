@@ -220,6 +220,23 @@ def _fold_tf_chunks(
     return _finish_tf(acc, distinct_dev, overflow_dev, cfg, cap, allow_overflow)
 
 
+def scores_from_tf(
+    tf: dict[tuple[bytes, int], int], n_docs: int
+) -> dict[tuple[bytes, int], float]:
+    """The tf-table -> score fold: ``score = tf * ln(n_docs / df)`` with
+    df counted over the pair table.  The ONE spelling — ``build_tfidf``
+    and the plan compiler's ``tfidf_score`` stage (plan/compile.py) both
+    call it, so the plan layer's byte-identity guarantee cannot drift
+    from a one-sided formula change."""
+    df: dict[bytes, int] = {}
+    for word, _ in tf:
+        df[word] = df.get(word, 0) + 1
+    return {
+        (word, doc): count * math.log(n_docs / df[word])
+        for (word, doc), count in tf.items()
+    }
+
+
 def build_tfidf(
     lines: list[bytes] | np.ndarray,
     doc_ids: np.ndarray,
@@ -235,10 +252,4 @@ def build_tfidf(
     ids = np.asarray(doc_ids, np.int32)
     tf = term_doc_counts(lines, ids, cfg, pairs_capacity, allow_overflow)
     n_docs = len(set(int(d) for d in ids)) or 1
-    df: dict[bytes, int] = {}
-    for word, _ in tf:
-        df[word] = df.get(word, 0) + 1
-    return {
-        (word, doc): count * math.log(n_docs / df[word])
-        for (word, doc), count in tf.items()
-    }
+    return scores_from_tf(tf, n_docs)

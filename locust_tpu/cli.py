@@ -312,7 +312,9 @@ def _run(args) -> int:
             file=sys.stderr,
         )
 
-    eng = MapReduceEngine(cfg)
+    # The single-device stage-0/1 path builds its engine INSIDE the
+    # compiled plan below; only the stage-2 reduce branch needs one
+    # directly (for the normalized combine), so nothing is built twice.
     inter = args.intermediate or [DEFAULT_INTERMEDIATE]
 
     if args.mesh and args.stage in (STAGE_SINGLE, STAGE_MAP):
@@ -322,6 +324,16 @@ def _run(args) -> int:
         return rc
 
     if args.stage in (STAGE_SINGLE, STAGE_MAP):
+        # WordCount runs as a compiled PLAN (docs/PLAN.md): the driver
+        # constructs the canonical DAG (source -> tokenize -> group ->
+        # sum -> table) and the compiler lowers it back onto this same
+        # engine — byte-identical output, the reference's staged timing
+        # report intact, and checkpoints still land at the fold-stage
+        # boundary (plan/compile.py).
+        from locust_tpu.plan import wordcount_plan
+        from locust_tpu.plan.compile import compile_plan
+
+        wc_plan = compile_plan(wordcount_plan(), cfg)
         with prof:
             with timer.span("load"), obs.span("cli.load"):
                 if args.stream:
@@ -344,6 +356,7 @@ def _run(args) -> int:
                     print(f"[locust] {rows.shape[0]} lines loaded", file=sys.stderr)
             with timer.span("run"), obs.span("cli.run"):
                 # Each run method syncs internally, so the span is accurate.
+                pairs = None
                 if args.stream:
                     kw = {}
                     if args.checkpoint_dir:
@@ -352,15 +365,21 @@ def _run(args) -> int:
                             every=args.checkpoint_every,
                             fingerprint=stream.fingerprint(),
                         )
-                    res = eng.run_stream(stream, **kw)
-                elif args.checkpoint_dir:
-                    res = eng.run_checkpointed(
-                        rows, args.checkpoint_dir, every=args.checkpoint_every
-                    )
-                elif args.no_timing:
-                    res = eng.run_fused(rows)
+                    res = wc_plan.run_stream(stream, **kw)
                 else:
-                    res = eng.timed_run(rows)
+                    pres = wc_plan.run(
+                        rows,
+                        timed=not args.no_timing,
+                        render=False,
+                        # The staged map node only dumps the raw table
+                        # (dump_intermediate): skip the host finalize
+                        # its output path would discard.
+                        finalize=args.stage != STAGE_MAP,
+                        checkpoint_dir=args.checkpoint_dir or None,
+                        every=args.checkpoint_every,
+                    )
+                    res = pres.run_result
+                    pairs = pres.value
             if args.stream and res.stream is not None:
                 # Zero-stall executor accounting: backpressure stall +
                 # checkpoint mark/write stats (engine.run_stream).
@@ -403,7 +422,12 @@ def _run(args) -> int:
                     print(f"[locust] node {args.node_num}: intermediate written to {out}",
                           file=sys.stderr)
                 else:
-                    _print_table(res.to_host_pairs(), args.limit)
+                    # The plan run already host-finalized the table
+                    # (PlanResult.value); the stream path decodes here.
+                    _print_table(
+                        pairs if pairs is not None else res.to_host_pairs(),
+                        args.limit,
+                    )
         if args.trace:
             print(timer.report(), file=sys.stderr)
         return 0
@@ -426,6 +450,7 @@ def _run(args) -> int:
         from locust_tpu.engine import finalize_host_pairs
         from locust_tpu.ops import segment_reduce, sort_and_compact
 
+        eng = MapReduceEngine(cfg)  # stage 2 only: the normalized combine
         with timer.span("run"), obs.span("cli.run"):
             table = segment_reduce(sort_and_compact(batch, cfg.sort_mode), eng.combine)
             pairs = finalize_host_pairs(table, eng.combine)  # device sync

@@ -3,7 +3,12 @@
 The reference's entire capability is CLI-driven (reference
 MapReduce/src/main.cu:358-387, README.md:12-24); ours matched that for
 WordCount but left PageRank / inverted index / TF-IDF library-only
-(VERDICT r3 missing #5).  These subcommands wire the existing apps:
+(VERDICT r3 missing #5).  Since the plan layer (docs/PLAN.md) these
+drivers no longer hand-wire stage chains: each one CONSTRUCTS the
+workload's canonical logical plan (locust_tpu/plan/builders.py) and runs
+it through the plan compiler, which lowers onto the same apps/engine
+primitives — output byte-identical to the pre-plan drivers (pinned by
+tests/test_plan.py).  These subcommands wire the existing apps:
 
   python -m locust_tpu pagerank <edges.txt> [--mesh] [--num-iters N]
   python -m locust_tpu index  <file> [--mesh] [--lines-per-doc K]
@@ -28,6 +33,8 @@ import sys
 
 import numpy as np
 
+from locust_tpu import obs  # jax-free; zero-overhead unless --trace-out
+
 SUBCOMMANDS = ("pagerank", "index", "tfidf")
 
 
@@ -35,6 +42,25 @@ def _add_backend_flag(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--backend", choices=["auto", "cpu", "tpu"], default="auto",
         help="auto: accelerator if its init probe passes, else CPU",
+    )
+    # Ladder/WordCount CLI parity: every subcommand takes the main CLI's
+    # observability + sort-strategy flags, so a plan-compiled ladder run
+    # is traceable and tunable with zero new plumbing.
+    from locust_tpu.config import SORT_MODES
+
+    p.add_argument(
+        "--sort-mode", choices=list(SORT_MODES), default=None,
+        help="Process-stage sort strategy (config.EngineConfig."
+             "sort_mode); default follows the measured per-backend "
+             "choice (config.default_sort_mode).  pagerank accepts it "
+             "for ladder parity only — its dense pipeline has no sort.",
+    )
+    p.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="structured telemetry (locust_tpu.obs): record the run's "
+             "spans/events/metrics (plan.compile/plan.run + engine "
+             "stages) and export a Chrome-trace JSON timeline to FILE "
+             "(docs/OBSERVABILITY.md)",
     )
 
 
@@ -70,27 +96,21 @@ def build_parser(cmd: str) -> argparse.ArgumentParser:
 
 
 def load_edges(path: str) -> tuple[np.ndarray, np.ndarray]:
-    """Parse a SNAP-style edge list; loud error on malformed lines."""
-    src, dst = [], []
+    """Parse a SNAP-style edge list; loud error on malformed lines.
+
+    Delegates to the ONE byte-level parser
+    (``plan.compile.edges_from_bytes``) so the CLI and a pagerank plan
+    submitted to the serve daemon can never disagree about the format;
+    the file path is prefixed onto any parse error for CLI context."""
+    from locust_tpu.plan import PlanError
+    from locust_tpu.plan.compile import edges_from_bytes
+
     with open(path, "rb") as f:
-        for ln_no, ln in enumerate(f, 1):
-            ln = ln.strip()
-            if not ln or ln.startswith(b"#"):
-                continue
-            parts = ln.split()
-            if len(parts) != 2:
-                raise ValueError(
-                    f"{path}:{ln_no}: expected 'src dst', got {ln[:60]!r}"
-                )
-            src.append(int(parts[0]))
-            dst.append(int(parts[1]))
-    if not src:
-        raise ValueError(f"{path}: no edges")
-    s = np.asarray(src, np.int64)
-    d = np.asarray(dst, np.int64)
-    if s.min() < 0 or d.min() < 0:
-        raise ValueError(f"{path}: negative node id")
-    return s, d
+        data = f.read()
+    try:
+        return edges_from_bytes(data)
+    except PlanError as e:
+        raise ValueError(f"{path}: {e}")
 
 
 
@@ -109,33 +129,26 @@ def run_pagerank(args) -> int:
             file=sys.stderr,
         )
         return 1
-    if args.mesh:
-        from locust_tpu.apps.pagerank import ShardedPageRank
-        from locust_tpu.parallel.mesh import make_mesh
+    from locust_tpu.plan import pagerank_plan
+    from locust_tpu.plan.compile import compile_plan
 
-        ranks = ShardedPageRank(make_mesh(), n, damping=args.damping).run(
-            src, dst, num_iters=args.num_iters
-        )
-    else:
-        from locust_tpu.apps.pagerank import pagerank
+    # The driver constructs the canonical plan and lets the compiler
+    # pick the lowering (apps.pagerank single-device vs ShardedPageRank
+    # under --mesh) — same value, byte-identical output (docs/PLAN.md).
+    ranks = compile_plan(
+        pagerank_plan(num_iters=args.num_iters, damping=args.damping),
+        mesh=args.mesh,
+    ).run((src, dst), num_nodes=n, render=False).value
+    from locust_tpu.plan.compile import rank_row
 
-        ranks = np.asarray(
-            pagerank(
-                np.asarray(src, np.int32),
-                np.asarray(dst, np.int32),
-                num_nodes=n,
-                num_iters=args.num_iters,
-                damping=args.damping,
-            )
-        )
     order = (
         np.argsort(-ranks, kind="stable")[: args.top]
         if args.top is not None
         else np.arange(n)
     )
-    out = sys.stdout
+    out = sys.stdout.buffer
     for node in order:
-        out.write(f"{node}\t{ranks[node]:.8f}\n")
+        out.write(rank_row(int(node), ranks[node]))
     out.flush()
     return 0
 
@@ -153,49 +166,51 @@ def _load_docs(args):
         emits_per_line=args.emits_per_line,
         # Measured per-backend Process default (backend already selected
         # by main's select_backend_cli); apps inherit the same fold wins.
-        sort_mode=default_sort_mode(jax.default_backend()),
+        # --sort-mode overrides it, same as the WordCount CLI.
+        sort_mode=args.sort_mode or default_sort_mode(jax.default_backend()),
     )
     rows = loader.load_rows(args.filename, cfg.line_width)
-    ids = (np.arange(rows.shape[0]) // args.lines_per_doc).astype(np.int32)
-    return cfg, rows, ids
+    return cfg, rows
 
 
 def run_index(args) -> int:
-    cfg, rows, ids = _load_docs(args)
-    if args.mesh:
-        from locust_tpu.apps.inverted_index import build_inverted_index_mesh
-        from locust_tpu.parallel.mesh import make_mesh
+    cfg, rows = _load_docs(args)
+    from locust_tpu.plan import index_plan
+    from locust_tpu.plan.compile import compile_plan
 
-        index = build_inverted_index_mesh(rows, ids, make_mesh(), cfg)
-    else:
-        from locust_tpu.apps.inverted_index import build_inverted_index
+    # Plan-compiled: the source node derives the line->doc sharding
+    # (``i // lines_per_doc``, the module contract above) and the
+    # compiler lowers onto build_inverted_index[_mesh].
+    index = compile_plan(
+        index_plan(args.lines_per_doc), cfg, mesh=args.mesh
+    ).run(rows, render=False).value
+    return _print_rendered("postings", index, args.limit)
 
-        index = build_inverted_index(rows, ids, cfg)
+
+def _print_rendered(op: str, value, limit) -> int:
+    """Print through the plan sink's ONE row renderer
+    (plan.compile.iter_rendered) — the driver's stdout and a plan
+    job's rendered result stay byte-identical by construction."""
+    from locust_tpu.plan.compile import iter_rendered
+
     out = sys.stdout.buffer
-    for i, word in enumerate(sorted(index)):
-        if args.limit is not None and i >= args.limit:
+    for i, row in enumerate(iter_rendered(op, value)):
+        if limit is not None and i >= limit:
             break
-        docs = b",".join(str(d).encode() for d in index[word])
-        out.write(word + b"\t" + docs + b"\n")
+        out.write(row)
     out.flush()
     return 0
 
 
 def run_tfidf(args) -> int:
-    cfg, rows, ids = _load_docs(args)
-    from locust_tpu.apps.tfidf import build_tfidf
+    cfg, rows = _load_docs(args)
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.plan.compile import compile_plan
 
-    scores = build_tfidf(rows, ids, cfg)
-    out = sys.stdout.buffer
-    for i, (word, doc) in enumerate(sorted(scores)):
-        if args.limit is not None and i >= args.limit:
-            break
-        out.write(
-            word + b"\t" + str(doc).encode()
-            + b"\t" + f"{scores[(word, doc)]:.6f}".encode() + b"\n"
-        )
-    out.flush()
-    return 0
+    scores = compile_plan(
+        tfidf_plan(args.lines_per_doc), cfg
+    ).run(rows, render=False).value
+    return _print_rendered("tfidf", scores, args.limit)
 
 
 def main(cmd: str, argv) -> int:
@@ -227,6 +242,8 @@ def main(cmd: str, argv) -> int:
 
     if select_backend_cli(args.backend) is None:
         return 1
+    if args.trace_out:
+        obs.enable(process="cli")
     try:
         if cmd == "pagerank":
             return run_pagerank(args)
@@ -236,3 +253,16 @@ def main(cmd: str, argv) -> int:
     except (OSError, ValueError) as e:
         print(f"locust_tpu: error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if args.trace_out:
+            # Same stance as the WordCount CLI: telemetry must not take
+            # down (or re-color) the run — an unwritable trace path is a
+            # warning, never the exit status.
+            try:
+                obs.export(args.trace_out)
+                print(f"[locust] trace written to {args.trace_out}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"[locust] trace export to {args.trace_out} "
+                      f"failed: {e}", file=sys.stderr)
+            obs.disable()

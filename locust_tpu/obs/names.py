@@ -37,6 +37,8 @@ NAMES = {
     "serve.dispatch": "span",       # serve: one coalesced batch dispatch
     "serve.place": "span",          # serve: pool placement decision (pool.py)
     "serve.demux": "span",          # serve: per-job result split + store
+    "plan.compile": "span",         # plan: DAG lowering onto the engine
+    "plan.run": "span",             # plan: one compiled-plan execution
     # --- instant events ----------------------------------------------
     "fault.injected": "event",      # a faultplan rule fired (site, action)
     "ckpt.mark": "event",           # fold loop marked a snapshot generation

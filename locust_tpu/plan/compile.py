@@ -1,0 +1,579 @@
+"""Lower a validated logical plan onto the existing engine/mesh tiers.
+
+NO new device code lives here (the tentpole's constraint): compilation
+pattern-matches subgraphs of the DAG onto the primitives the repo
+already trusts —
+
+  * ``map(tokenize_count) → shuffle(by_key) → reduce(sum)`` over a text
+    source fuses into the engine's one-sort-per-block fold
+    (``MapReduceEngine``; ``DistributedMapReduce`` under ``mesh=True``),
+    exactly the WordCount pipeline — so a plan-compiled run IS the
+    hand-wired run, byte for byte, and checkpoint placement rides the
+    fold-stage boundary (``run_checkpointed``/``run_stream``);
+  * ``map(tokenize_pairs) → shuffle → reduce(sum)`` fuses into the
+    composite-key tf fold (``apps.tfidf.term_doc_counts``);
+  * ``map(tokenize_pairs) → shuffle → reduce(collect_docs)`` fuses into
+    the inverted-index fold (``apps.inverted_index``, mesh variant under
+    ``mesh=True``);
+  * ``map(tfidf_score)`` over a tf table is the host-side rescore fold
+    (df/n_docs over a table orders of magnitude smaller than the
+    corpus — the ``build_tfidf`` stance);
+  * ``iterate(pagerank)`` over an edge source lowers onto
+    ``apps.pagerank`` (``ShardedPageRank`` under ``mesh=True``);
+  * ``join(inner)`` merges two terminal tables on key — a host fold
+    over device-built tables, like every other table-level finalize;
+  * ``sink`` renders the terminal value to the EXACT bytes the
+    hand-wired CLI drivers print (the byte-identity contract the tests
+    pin).
+
+A composition outside these signatures is a loud ``PlanError`` at
+compile time, never a silently-wrong execution.  jax-free at import
+(jax enters inside ``run``) so the serve control plane can compile-check
+plans without a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from locust_tpu import obs
+from locust_tpu.plan.nodes import Node, Plan, PlanError
+
+# Serve-side bound on the pagerank state size: ``num_nodes`` derives
+# from the max node id in the CORPUS, so a 12-byte submit naming node
+# 2e9 would otherwise allocate multi-GB dense rank/degree vectors inside
+# the multi-tenant daemon (overload must reject, never OOM —
+# serve/daemon.py).  2^24 nodes ≈ 67 MB per dense float32 vector.  The
+# CLI path (``run()``) stays unbounded like the pre-plan driver: a
+# single-tenant process may spend its own memory.
+SERVE_MAX_PAGERANK_NODES = 1 << 24
+
+# Lowered stage shapes (the compiler's internal vocabulary; every
+# NODE_KINDS entry is matched somewhere below — analysis rule R014
+# checks this file for exactly that).
+_FOLDS = {
+    ("tokenize_count", "sum"): "wordcount",
+    ("tokenize_pairs", "sum"): "tf",
+    ("tokenize_pairs", "collect_docs"): "index",
+}
+
+
+@dataclasses.dataclass
+class PlanResult:
+    """One executed plan: the workload-shaped ``value`` (pairs list /
+    dict / ranks array), the sink-rendered ``output`` bytes (``None``
+    when ``render=False``), and the loss/limit accounting the serve
+    tier reports."""
+
+    value: object
+    output: bytes | None
+    distinct: int
+    truncated: bool
+    overflow_tokens: int
+    run_result: object | None = None  # engine RunResult (wordcount fold)
+
+
+class CompiledPlan:
+    """A plan lowered to an executable stage tree.
+
+    Holds the underlying engine lazily and reuses it across ``run``
+    calls, so a resident ``CompiledPlan`` (the serve tier's warm-
+    executable cache holds these for plan jobs) keeps its jit caches
+    warm exactly like a resident ``MapReduceEngine`` does.
+    """
+
+    def __init__(self, plan: Plan, cfg=None, mesh: bool = False):
+        self.plan = plan
+        self.cfg = cfg
+        self.mesh = mesh
+        self._engine = None  # lazy MapReduceEngine (wordcount fold)
+        with obs.span("plan.compile", plan=plan.fingerprint()):
+            self._by_id = plan.by_id()
+            self._sink = plan.sink()
+            self._stages: dict[str, tuple] = {}
+            self._root = self._lower(self._sink.id)
+        if cfg is None and any(
+            n.kind == "source" and n.op == "text" for n in plan.nodes
+        ):
+            raise PlanError(
+                "a plan with a text source needs an EngineConfig"
+            )
+        if mesh and self._needs_mesh_guard():
+            raise PlanError(
+                "the tf fold has no mesh lowering (the pair table is "
+                "device-bounded; use the index plan for the distributed "
+                "path)"
+            )
+
+    def _needs_mesh_guard(self) -> bool:
+        return any(
+            s[0] == "fold" and s[1] == "tf" for s in self._stages.values()
+        )
+
+    # ------------------------------------------------------------ lowering
+
+    def _lower(self, nid: str) -> str:
+        """Classify node ``nid`` (and its producers) into a stage;
+        returns the stage id (== node id).  Memoized so a multi-consumer
+        node lowers (and later executes) once."""
+        if nid in self._stages:
+            return nid
+        n = self._by_id[nid]
+        if n.kind == "source":
+            stage = ("source", n)
+        elif n.kind == "reduce":
+            shuf = self._by_id[n.inputs[0]]
+            if shuf.kind != "shuffle":
+                raise PlanError(
+                    f"node {n.id!r}: reduce must consume a shuffle node "
+                    "(the engine fuses group+combine into one sort)"
+                )
+            mapper = self._by_id[shuf.inputs[0]]
+            if mapper.kind != "map":
+                raise PlanError(
+                    f"node {shuf.id!r}: shuffle must consume a map node"
+                )
+            fold = _FOLDS.get((mapper.op, n.op))
+            if fold is None:
+                raise PlanError(
+                    f"node {n.id!r}: no fold lowering for map "
+                    f"{mapper.op!r} + reduce {n.op!r}"
+                )
+            src_id = self._lower(mapper.inputs[0])
+            stage = ("fold", fold, src_id)
+        elif n.kind == "map" and n.op == "tfidf_score":
+            tf_id = self._lower(n.inputs[0])
+            tf_stage = self._stages[tf_id]
+            if not (tf_stage[0] == "fold" and tf_stage[1] == "tf"):
+                raise PlanError(
+                    f"node {n.id!r}: tfidf_score must consume the tf fold"
+                )
+            stage = ("score", tf_id)
+        elif n.kind == "map":
+            # tokenize maps only exist fused under a shuffle+reduce; a
+            # bare token stream has no materialization (the fixed-slot
+            # emit tensor is an engine-internal shape).
+            raise PlanError(
+                f"node {n.id!r}: map {n.op!r} must feed a "
+                "shuffle -> reduce chain"
+            )
+        elif n.kind == "shuffle":
+            raise PlanError(
+                f"node {n.id!r}: shuffle must feed a reduce node (the "
+                "engine's one-sort fold groups and combines together)"
+            )
+        elif n.kind == "join":
+            left = self._lower(n.inputs[0])
+            right = self._lower(n.inputs[1])
+            stage = ("join", left, right, n.param("combine", "sum"))
+        elif n.kind == "iterate":
+            src_id = self._lower(n.inputs[0])
+            src = self._by_id[src_id]
+            if not (src.kind == "source" and src.op == "edges"):
+                raise PlanError(
+                    f"node {n.id!r}: iterate(pagerank) must consume an "
+                    "edges source"
+                )
+            stage = ("pagerank", src_id,
+                     n.param("num_iters", 20), n.param("damping", 0.85))
+        elif n.kind == "sink":
+            stage = ("render", n.op, self._lower(n.inputs[0]))
+        else:  # pragma: no cover - Plan validation owns kind closure
+            raise PlanError(f"node {n.id!r}: unknown kind {n.kind!r}")
+        self._stages[nid] = stage
+        return nid
+
+    # ----------------------------------------------------------- execution
+
+    def run(
+        self,
+        data=None,
+        *,
+        num_nodes: int | None = None,
+        max_nodes: int | None = None,
+        timed: bool = False,
+        render: bool = True,
+        finalize: bool = True,
+        checkpoint_dir: str | None = None,
+        every: int = 8,
+    ) -> PlanResult:
+        """Execute the compiled plan.
+
+        ``data`` feeds the source node(s): a rows array / list of line
+        bytes for a text source, an ``(src, dst)`` edge-array pair for
+        an edges source, or a ``{input_name: data}`` dict when sources
+        name distinct inputs (``source`` param ``input``; default
+        ``"corpus"``).  ``timed`` routes the wordcount fold through
+        ``timed_run`` (the reference's stage report); ``checkpoint_dir``
+        places crash-resumable snapshots at the fold-stage boundary
+        (``run_checkpointed``).  ``render=False`` skips the sink's
+        output-bytes rendering (CLI drivers print from ``value``);
+        ``finalize=False`` additionally skips the wordcount fold's
+        host-pairs decode (``value`` comes back None, ``run_result``
+        carries the device table) — for callers like the CLI's staged
+        map node that only dump the raw table, where the full decode
+        would be paid and discarded.  Only a plan whose sink consumes
+        the wordcount fold directly may skip it.
+        """
+        stage = self._stages[self._stages[self._root][2]]
+        if not finalize and not (
+            stage[0] == "fold" and stage[1] == "wordcount"
+        ):
+            raise PlanError(
+                "finalize=False is only meaningful for a sink fed by "
+                "the wordcount fold (other stages need the decoded value)"
+            )
+        if not finalize and render:
+            # There is no decoded value to render — a None would reach
+            # _render as a raw TypeError instead of a loud PlanError.
+            raise PlanError("finalize=False requires render=False")
+        with obs.span("plan.run", plan=self.plan.fingerprint()):
+            ctx = _RunCtx(self, data, num_nodes, timed,
+                          checkpoint_dir, every, finalize=finalize,
+                          max_nodes=max_nodes)
+            value = ctx.eval(self._stages[self._root][2])
+            render_op = self._stages[self._root][1]
+            output = _render(render_op, value) if render else None
+            distinct, truncated, overflow = ctx.accounting(
+                self._stages[self._root][2], value
+            )
+            return PlanResult(
+                value=value, output=output, distinct=distinct,
+                truncated=truncated, overflow_tokens=overflow,
+                run_result=ctx.run_result,
+            )
+
+    def run_stream(self, blocks, **kw):
+        """Bounded-memory passthrough for a pure wordcount-fold plan:
+        delegates to ``MapReduceEngine.run_stream`` (same checkpoint/
+        resume contract) and returns the raw ``RunResult`` — the
+        streaming CLI's existing stall/ckpt accounting rides it
+        unchanged."""
+        stage = self._stages[self._stages[self._root][2]]
+        if not (stage[0] == "fold" and stage[1] == "wordcount"
+                and not self.mesh):
+            raise PlanError(
+                "run_stream supports the single-device wordcount fold "
+                "plan only"
+            )
+        return self._wordcount_engine().run_stream(blocks, **kw)
+
+    def run_corpus(self, corpus: bytes) -> PlanResult:
+        """The serve tier's entry: raw corpus bytes in, rendered result
+        out.  Text sources split lines exactly like the daemon's batch
+        stager (``serve/batch.split_lines``); edge sources parse the
+        SNAP ``src dst`` format exactly like the CLI
+        (``cli_apps.load_edges``).  ONE corpus only: a plan whose
+        sources name distinct ``input``s would silently self-join the
+        same bytes — loud instead (``parse_spec`` rejects it as
+        ``bad_spec`` before admission; this is the dispatch-side
+        defense)."""
+        named = sorted({
+            n.param("input", "corpus")
+            for n in self.plan.nodes if n.kind == "source"
+        } - {"corpus"})
+        if named:
+            raise PlanError(
+                f"run_corpus feeds ONE corpus; this plan's sources name "
+                f"distinct inputs {named} — submit it through run() "
+                "with a data dict instead"
+            )
+        if any(n.kind == "source" and n.op == "edges"
+               for n in self.plan.nodes):
+            src, dst = edges_from_bytes(corpus)
+            return self.run(
+                (src, dst), max_nodes=SERVE_MAX_PAGERANK_NODES
+            )
+        return self.run(corpus.splitlines())
+
+    def _wordcount_engine(self):
+        if self._engine is None:
+            from locust_tpu.engine import MapReduceEngine
+
+            self._engine = MapReduceEngine(self.cfg)
+        return self._engine
+
+
+def compile_plan(plan: Plan, cfg=None, mesh: bool = False) -> CompiledPlan:
+    """Lower ``plan`` onto the engine tier; raises ``PlanError`` on any
+    composition outside the supported signatures (docs/PLAN.md)."""
+    return CompiledPlan(plan, cfg=cfg, mesh=mesh)
+
+
+class _RunCtx:
+    """One plan execution: stage memo + source staging + accounting."""
+
+    def __init__(self, cp: CompiledPlan, data, num_nodes, timed,
+                 checkpoint_dir, every, finalize: bool = True,
+                 max_nodes: int | None = None):
+        self.cp = cp
+        self.data = data
+        self.num_nodes = num_nodes
+        self.max_nodes = max_nodes
+        self.timed = timed
+        self.checkpoint_dir = checkpoint_dir
+        self.every = every
+        self.finalize = finalize
+        self.run_result = None
+        self._memo: dict[str, object] = {}
+        self._acct: dict[str, tuple] = {}  # stage id -> (dist, trunc, ovf)
+
+    # -------------------------------------------------------------- eval
+
+    def eval(self, sid: str):
+        if sid in self._memo:
+            return self._memo[sid]
+        stage = self.cp._stages[sid]
+        kind = stage[0]
+        if kind == "source":
+            out = self._eval_source(stage[1])
+        elif kind == "fold":
+            out = self._eval_fold(sid, stage)
+        elif kind == "score":
+            out = self._eval_score(stage)
+        elif kind == "join":
+            out = self._eval_join(sid, stage)
+        elif kind == "pagerank":
+            out = self._eval_pagerank(sid, stage)
+        else:  # pragma: no cover - render handled by run()
+            raise PlanError(f"unexpected stage {kind!r}")
+        self._memo[sid] = out
+        return out
+
+    def _source_data(self, n: Node):
+        name = n.param("input", "corpus")
+        data = self.data
+        if isinstance(data, dict):
+            if name not in data:
+                raise PlanError(
+                    f"source {n.id!r}: no input named {name!r} in the "
+                    f"run data (have: {sorted(data)})"
+                )
+            data = data[name]
+        if data is None:
+            raise PlanError(f"source {n.id!r}: run() got no input data")
+        return data
+
+    def _eval_source(self, n: Node):
+        import numpy as np
+
+        data = self._source_data(n)
+        if n.op == "edges":
+            src, dst = data
+            return np.asarray(src), np.asarray(dst)
+        from locust_tpu.core import bytes_ops
+
+        cfg = self.cp.cfg
+        rows = (
+            data
+            if isinstance(data, np.ndarray)
+            else bytes_ops.strings_to_rows(list(data), cfg.line_width)
+        )
+        k = n.param("lines_per_doc", 1)
+        ids = (np.arange(rows.shape[0]) // k).astype(np.int32)
+        return rows, ids
+
+    def _eval_fold(self, sid: str, stage):
+        fold = stage[1]
+        src_node = self.cp._stages[stage[2]][1]
+        rows, ids = self.eval(stage[2])
+        cfg, mesh = self.cp.cfg, self.cp.mesh
+        if fold == "wordcount":
+            if mesh:
+                from locust_tpu.parallel.mesh import make_mesh
+                from locust_tpu.parallel.shuffle import DistributedMapReduce
+
+                res = DistributedMapReduce(make_mesh(), cfg).run(rows)
+                pairs = res.to_host_pairs() if self.finalize else None
+                self._acct[sid] = (
+                    res.distinct, res.truncated, res.emit_overflow
+                )
+            else:
+                eng = self.cp._wordcount_engine()
+                if self.checkpoint_dir:
+                    res = eng.run_checkpointed(
+                        rows, self.checkpoint_dir, every=self.every
+                    )
+                elif self.timed:
+                    res = eng.timed_run(rows)
+                else:
+                    res = eng.run_fused(rows)
+                self.run_result = res
+                pairs = res.to_host_pairs() if self.finalize else None
+                self._acct[sid] = (
+                    res.num_segments, res.truncated, res.overflow_tokens
+                )
+            return pairs
+        if fold == "tf":
+            from locust_tpu.apps.tfidf import term_doc_counts
+
+            tf = term_doc_counts(rows, ids, cfg)
+            self._acct[sid] = (len(tf), False, 0)
+            # The score stage needs n_docs exactly as build_tfidf
+            # derives it: distinct ids over the INPUT, not the table
+            # (a doc whose lines carry no tokens still counts).
+            self._memo[f"{sid}.n_docs"] = (
+                len(set(int(d) for d in ids)) or 1
+            )
+            return tf
+        if fold == "index":
+            if mesh:
+                from locust_tpu.apps.inverted_index import (
+                    build_inverted_index_mesh,
+                )
+                from locust_tpu.parallel.mesh import make_mesh
+
+                index = build_inverted_index_mesh(
+                    rows, ids, make_mesh(), cfg
+                )
+            else:
+                from locust_tpu.apps.inverted_index import (
+                    build_inverted_index,
+                )
+
+                index = build_inverted_index(rows, ids, cfg)
+            self._acct[sid] = (len(index), False, 0)
+            return index
+        raise PlanError(  # pragma: no cover - _FOLDS is closed
+            f"unknown fold {fold!r} (source {src_node.id!r})"
+        )
+
+    def _eval_score(self, stage):
+        from locust_tpu.apps.tfidf import scores_from_tf
+
+        tf = self.eval(stage[1])
+        return scores_from_tf(tf, self._memo[f"{stage[1]}.n_docs"])
+
+    def _eval_join(self, sid: str, stage):
+        _, left_id, right_id, combine = stage
+        left = dict(self.eval(left_id))
+        right = dict(self.eval(right_id))
+        op = {
+            "sum": lambda a, b: a + b,
+            "mul": lambda a, b: a * b,
+            "min": min,
+        }[combine]
+        pairs = sorted(
+            (k, op(v, right[k])) for k, v in left.items() if k in right
+        )
+        self._acct[sid] = (len(pairs), False, 0)
+        return pairs
+
+    def _eval_pagerank(self, sid: str, stage):
+        import numpy as np
+
+        _, src_id, num_iters, damping = stage
+        src, dst = self.eval(src_id)
+        n = (
+            self.num_nodes
+            if self.num_nodes is not None
+            else int(max(int(src.max()), int(dst.max()))) + 1
+        )
+        if self.max_nodes is not None and n > self.max_nodes:
+            # Serve-side bound (SERVE_MAX_PAGERANK_NODES): the node
+            # count derives from corpus CONTENT, so a tiny submit
+            # naming a huge id must reject, not allocate.
+            raise PlanError(
+                f"pagerank needs {n} dense node slots, past this "
+                f"endpoint's cap ({self.max_nodes}); renumber the "
+                "graph or run it through the CLI"
+            )
+        if self.cp.mesh:
+            from locust_tpu.apps.pagerank import ShardedPageRank
+            from locust_tpu.parallel.mesh import make_mesh
+
+            ranks = ShardedPageRank(make_mesh(), n, damping=damping).run(
+                src, dst, num_iters=num_iters
+            )
+        else:
+            from locust_tpu.apps.pagerank import pagerank
+
+            ranks = np.asarray(pagerank(
+                np.asarray(src, np.int32), np.asarray(dst, np.int32),
+                num_nodes=n, num_iters=num_iters, damping=damping,
+            ))
+        self._acct[sid] = (n, False, 0)
+        return ranks
+
+    def accounting(self, sid: str, value) -> tuple:
+        got = self._acct.get(sid)
+        if got is not None:
+            return got
+        try:
+            return len(value), False, 0
+        except TypeError:
+            return 0, False, 0
+
+
+def rank_row(node: int, rank: float) -> bytes:
+    """ONE spelling of a pagerank output row — the ``ranks`` sink and
+    the driver's ``--top`` path (which reorders rows) both use it, so
+    the formats cannot drift apart."""
+    return f"{node}\t{rank:.8f}\n".encode()
+
+
+def iter_rendered(op: str, value):
+    """Per-row sink rendering, the ONE spelling of each workload's
+    output format: ``_render`` joins it for plan results, and the
+    hand-wired CLI drivers (``cli_apps``) iterate it directly (honoring
+    ``--limit``) — byte-identity holds by construction, not by parallel
+    maintenance."""
+    if op == "table":
+        for k, v in value:  # pairs are already host-finalized + sorted
+            yield k + b"\t" + str(v).encode() + b"\n"
+    elif op == "tfidf":
+        for word, doc in sorted(value):
+            yield (
+                word + b"\t" + str(doc).encode()
+                + b"\t" + f"{value[(word, doc)]:.6f}".encode() + b"\n"
+            )
+    elif op == "postings":
+        for word in sorted(value):
+            docs = b",".join(str(d).encode() for d in value[word])
+            yield word + b"\t" + docs + b"\n"
+    elif op == "ranks":
+        for i in range(value.shape[0]):
+            yield rank_row(i, value[i])
+    else:  # pragma: no cover - NODE_OPS closes the sink set
+        raise PlanError(f"unknown sink op {op!r}")
+
+
+def _render(op: str, value) -> bytes:
+    """Sink rendering: byte-for-byte the hand-wired drivers' stdout —
+    the byte-identity contract serve plan results ride."""
+    return b"".join(iter_rendered(op, value))
+
+
+def edges_from_bytes(corpus: bytes):
+    """SNAP-style ``src dst`` edge list from raw bytes.  The ONE parser
+    (comment/2-field/int/negative-id rules): ``cli_apps.load_edges``
+    delegates here, so a pagerank plan submitted to the daemon parses
+    its corpus exactly like the CLI parses a file — by construction,
+    not by parallel maintenance."""
+    import numpy as np
+
+    src, dst = [], []
+    for ln_no, ln in enumerate(corpus.splitlines(), 1):
+        ln = ln.strip()
+        if not ln or ln.startswith(b"#"):
+            continue
+        parts = ln.split()
+        if len(parts) != 2:
+            raise PlanError(
+                f"edge list line {ln_no}: expected 'src dst', got "
+                f"{ln[:60]!r}"
+            )
+        try:
+            src.append(int(parts[0]))
+            dst.append(int(parts[1]))
+        except ValueError:
+            raise PlanError(
+                f"edge list line {ln_no}: non-integer node id {ln[:60]!r}"
+            )
+    if not src:
+        raise PlanError("edge list has no edges")
+    s = np.asarray(src, np.int64)
+    d = np.asarray(dst, np.int64)
+    if s.min() < 0 or d.min() < 0:
+        raise PlanError("edge list has a negative node id")
+    return s, d

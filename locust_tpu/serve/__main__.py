@@ -9,6 +9,11 @@
 
     python -m locust_tpu.serve submit FILE [--tenant T] [--weight W]
         [--block-lines N] [--sort-mode M] [--no-wait] ...   # one job
+    python -m locust_tpu.serve submit FILE --plan PLAN.json # a dataflow
+        # plan job (docs/PLAN.md): FILE is the corpus (text or an edge
+        # list), PLAN.json the validated plan document; the result is
+        # the pipeline's rendered output, byte-identical to the
+        # hand-wired CLI over the same input
     python -m locust_tpu.serve result JOB_ID [--wait]       # fetch by id
     python -m locust_tpu.serve stats                        # daemon stats
     python -m locust_tpu.serve shutdown                     # stop it
@@ -129,7 +134,10 @@ def _submit_main(argv) -> int:
     p.add_argument("--port", type=int, default=1347)
     p.add_argument("--secret-env", default="LOCUST_SECRET")
     p.add_argument("--tenant", default="default")
-    p.add_argument("--workload", default="wordcount")
+    # Default None, not "wordcount": an explicitly named workload must
+    # stay distinguishable so --plan + --workload is a loud conflict
+    # (the client fills in the wordcount default for plain submits).
+    p.add_argument("--workload", default=None)
     p.add_argument("--weight", type=float, default=1.0)
     p.add_argument("--block-lines", type=int, default=None)
     p.add_argument("--sort-mode", default=None)
@@ -147,9 +155,22 @@ def _submit_main(argv) -> int:
                    help="drop any cached result for this job first")
     p.add_argument("--no-wait", action="store_true",
                    help="print the job id and return without waiting")
+    p.add_argument("--plan", default=None, metavar="PLAN.json",
+                   help="submit FILE through a composable dataflow plan "
+                        "(a JSON plan document, docs/PLAN.md) instead of "
+                        "a named workload; the daemon validates it and "
+                        "keys its caches off the plan fingerprint")
     args = p.parse_args(argv)
     with open(args.file, "rb") as f:
         corpus = f.read()
+    plan_doc = None
+    if args.plan is not None:
+        if args.workload is not None:
+            print("error: submit takes --plan OR --workload, not both",
+                  file=sys.stderr)
+            return 2
+        with open(args.plan, "r", encoding="utf-8") as f:
+            plan_doc = f.read()
     config = {
         k: v
         for k, v in (
@@ -168,6 +189,7 @@ def _submit_main(argv) -> int:
         config=config or None, weight=args.weight,
         invalidate=args.invalidate,
         deadline_s=args.deadline, max_attempts=args.max_attempts,
+        plan=plan_doc,
     )
     print(f"[serve] job {ack['job_id']} {ack['state']}"
           + (" (cached)" if ack.get("cached") else ""), file=sys.stderr)
@@ -179,8 +201,16 @@ def _submit_main(argv) -> int:
 
 
 def _print_result(res: dict) -> None:
-    for k, v in sorted(res["pairs"]):
-        sys.stdout.buffer.write(k + b"\t" + str(v).encode() + b"\n")
+    if res.get("plan"):
+        # A plan job's result is the pipeline's sink-rendered output as
+        # ONE (bytes, 0) pair — print it raw, byte-identical to the
+        # hand-wired CLI (docs/PLAN.md), not as a key<TAB>count table.
+        for k, _ in res["pairs"]:
+            sys.stdout.buffer.write(k)
+        sys.stdout.buffer.flush()
+    else:
+        for k, v in sorted(res["pairs"]):
+            sys.stdout.buffer.write(k + b"\t" + str(v).encode() + b"\n")
     print(
         f"[serve] {res['distinct']} distinct, cache={res['cache']}, "
         f"latency {res['latency_ms']} ms", file=sys.stderr,

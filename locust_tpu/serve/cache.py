@@ -40,7 +40,12 @@ import os
 import threading
 
 from locust_tpu.config import EngineConfig
-from locust_tpu.serve.jobs import WORKLOADS, JobSpec, pairs_bytes
+from locust_tpu.serve.jobs import (
+    PLAN_WORKLOAD,
+    WORKLOADS,
+    JobSpec,
+    pairs_bytes,
+)
 
 logger = logging.getLogger("locust_tpu")
 
@@ -99,6 +104,16 @@ class ExecutableCache:
 
     @staticmethod
     def engine_key(spec: JobSpec) -> tuple:
+        if spec.plan is not None:
+            # Plan jobs: the compiled executable is the (plan, config)
+            # pair, so the plan fingerprint IS the workload half of the
+            # key — two different pipelines can never share a warm
+            # engine, and a repeat of the same plan always hits
+            # (docs/PLAN.md).
+            return (
+                PLAN_WORKLOAD, spec.plan_fingerprint(),
+                spec.cfg.fingerprint(),
+            )
         return (spec.workload, spec.cfg.fingerprint())
 
     def lookup(self, spec: JobSpec, njobs: int, bucket: int):
@@ -119,10 +134,21 @@ class ExecutableCache:
         # Build OUTSIDE the lock: engine construction imports/compiles
         # nothing device-side yet, but it is not free and must not block
         # concurrent lookups of already-warm keys.
-        from locust_tpu.engine import MapReduceEngine
+        if spec.plan is not None:
+            # Plan jobs hold a CompiledPlan instead of a bare engine:
+            # same LRU, same shape ledger, same warm-hit economics (the
+            # compiled plan keeps its underlying engine's jit caches).
+            from locust_tpu.plan import from_json
+            from locust_tpu.plan.compile import compile_plan
 
-        map_fn, combine = _resolve_workload(spec.workload)
-        built = MapReduceEngine(spec.cfg, map_fn=map_fn, combine=combine)
+            built = compile_plan(from_json(spec.plan), spec.cfg)
+        else:
+            from locust_tpu.engine import MapReduceEngine
+
+            map_fn, combine = _resolve_workload(spec.workload)
+            built = MapReduceEngine(
+                spec.cfg, map_fn=map_fn, combine=combine
+            )
         with self._lock:
             eng = self._engines.get(key)
             if eng is None:  # we won the (benign) build race

@@ -67,26 +67,48 @@ class ServeClient:
         corpus: bytes | None = None,
         path: str | None = None,
         tenant: str = "default",
-        workload: str = "wordcount",
+        workload: str | None = None,
         config: dict | None = None,
         weight: float = 1.0,
         invalidate: bool = False,
         no_cache: bool = False,
         deadline_s: float | None = None,
         max_attempts: int | None = None,
+        plan: dict | str | None = None,
     ) -> dict:
         """Submit one job; returns the daemon's ack ({job_id, state,
         cached}).  Raises ``ServeError`` on a structured rejection.
         ``deadline_s``/``max_attempts`` are the job's robustness budgets
         (docs/SERVING.md): expiry anywhere answers ``deadline_exceeded``,
         a job that kills ``max_attempts`` dispatches is quarantined as
-        ``poison_job``."""
+        ``poison_job``.  ``plan`` submits a composable dataflow plan
+        (a plan document dict or its JSON text, docs/PLAN.md) instead of
+        a named workload; the result is the pipeline's rendered output
+        bytes as one (bytes, 0) pair, flagged ``plan`` in the reply."""
         req: dict = {
             "cmd": "submit",
             "tenant": tenant,
-            "workload": workload,
             "weight": weight,
         }
+        if plan is not None:
+            # Mirror the daemon's parse_spec rule EXACTLY (workload
+            # None or the reserved "plan" name may ride a plan submit;
+            # anything else is conflicting intent) instead of silently
+            # dropping the caller's workload — the default is None so
+            # an explicitly stated workload is always distinguishable.
+            if workload not in (None, "plan"):
+                raise ValueError(
+                    "submit takes a plan OR a workload name, not both"
+                )
+            req["plan"] = plan
+            # The reserved name rides ALONGSIDE the plan: a pre-plan
+            # daemon ignores the unknown "plan" key, and without this it
+            # would default the submit to wordcount and answer a wrong
+            # but "done" table — with it, the old build rejects loudly
+            # with unknown_workload (never a silent wrong answer).
+            req["workload"] = "plan"
+        else:
+            req["workload"] = workload or "wordcount"
         if config:
             req["config"] = dict(config)
         if invalidate:

@@ -70,6 +70,7 @@ from locust_tpu.serve.jobs import (
     structured_error,
 )
 from locust_tpu.serve.jobs import pairs_bytes as jobs_pairs_bytes
+from locust_tpu.plan import PlanError
 from locust_tpu.serve.journal import JobJournal
 from locust_tpu.serve.pool import PoolDispatchError
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler
@@ -705,6 +706,10 @@ class ServeDaemon:
             "job_id": job.job_id,
             "state": "done",
             "cache": job.cache,
+            # Plan results are ONE (rendered-output-bytes, 0) pair; the
+            # flag tells clients to print the key raw instead of as a
+            # key<TAB>count table (docs/PLAN.md).
+            "plan": job.spec.plan is not None,
             "distinct": job.distinct,
             "truncated": job.truncated,
             "overflow_tokens": job.overflow_tokens,
@@ -794,11 +799,19 @@ class ServeDaemon:
     def _batch_key(self, job: Job):
         # bisect_group keeps the halves of a failed batch from
         # re-coalescing (jobs.Job.bisect_group): None for never-failed
-        # jobs, so the common path batches exactly as before.
+        # jobs, so the common path batches exactly as before.  The
+        # engine_key half already folds the PLAN fingerprint in for plan
+        # jobs (cache.ExecutableCache.engine_key), so two different
+        # pipelines can never coalesce.
         key = (
             self.executables.engine_key(job.spec), job.bucket,
             job.bisect_group,
         )
+        if job.spec.plan is not None:
+            # Plan jobs dispatch solo: a compiled plan runs one corpus
+            # end-to-end (no vmapped job axis), so nothing may coalesce
+            # with it — same stance as shard-eligible jobs.
+            return key + (("solo", job.job_id),)
         if self.pool is not None and self._shardable(job):
             # Shard-eligible jobs dispatch solo: the fan-out owns the
             # whole batch, so nothing may coalesce with it.
@@ -814,8 +827,13 @@ class ServeDaemon:
         return (self.executables.engine_key(job.spec), job.bucket)
 
     def _shardable(self, job: Job) -> bool:
+        # Plan jobs never shard or place remotely: the worker serve
+        # surface speaks (workload, config) batches, and a multi-stage
+        # plan's intermediate state lives in its compiled executor —
+        # the local engine is their floor AND ceiling (docs/PLAN.md).
         return (
             self.pool is not None
+            and job.spec.plan is None
             and self.cfg.shard_max >= 2
             and job.n_blocks >= self.cfg.shard_min_blocks
         )
@@ -915,7 +933,8 @@ class ServeDaemon:
                 continue
             worker = (
                 self.pool.place(self._affinity_key(jobs[0]))
-                if self.pool is not None else None
+                if self.pool is not None and jobs[0].spec.plan is None
+                else None
             )
             if worker is not None:
                 try:
@@ -969,6 +988,8 @@ class ServeDaemon:
     def _dispatch_local(self, jobs: list[Job], corpora: dict) -> None:
         """One batch on the daemon's own engine — the pre-pool path and
         the pool's permanent floor."""
+        if jobs[0].spec.plan is not None:
+            return self._dispatch_plan(jobs[0], corpora)
         spec = jobs[0].spec
         njobs_padded = batching.bucket_blocks(len(jobs))
         bucket = jobs[0].bucket
@@ -1021,6 +1042,62 @@ class ServeDaemon:
         except Exception as e:  # noqa: BLE001 - jobs retry/fail, daemon survives
             logger.exception("serve dispatch failed")
             self._retry_or_fail(jobs, corpora, f"{type(e).__name__}: {e}")
+
+    def _dispatch_plan(self, job: Job, corpora: dict) -> None:
+        """One plan job on the daemon's own engine (docs/PLAN.md).
+
+        The warm-executable cache holds the COMPILED PLAN keyed by
+        (plan fingerprint, config fingerprint, shape bucket) — a repeat
+        of the same pipeline skips lowering and reuses the underlying
+        engine's jit caches, the exact warm-hit economics named
+        workloads get.  The result is the sink-rendered output bytes as
+        ONE (bytes, 0) pair, so the result cache, warm persistence,
+        history byte caps and journal replay all carry it unchanged;
+        failures feed the same retry ladder as every other dispatch.
+        """
+        spec = job.spec
+        try:
+            with self._engine_lock:
+                with obs.span(
+                    "serve.compile_or_hit", jobs=1, bucket=job.bucket,
+                ):
+                    executor, hit = self.executables.lookup(
+                        spec, 1, job.bucket
+                    )
+                if hit:
+                    obs.metric_inc("serve.exec_cache_hits")
+                else:
+                    obs.metric_inc("serve.exec_cache_misses")
+                job.placed_on = "local"
+                with obs.span(
+                    "serve.dispatch", jobs=1, bucket=job.bucket,
+                ):
+                    pres = executor.run_corpus(
+                        corpora[job.corpus_digest]
+                    )
+                self.executables.mark_compiled(spec, 1, job.bucket)
+                with obs.span("serve.demux", jobs=1):
+                    self._finish_job(
+                        job, [(pres.output, 0)], pres.distinct,
+                        pres.truncated, pres.overflow_tokens,
+                        "warm" if hit else "cold", time.monotonic(),
+                    )
+        except PlanError as e:
+            # DETERMINISTIC rejection (e.g. a pagerank plan over a
+            # corpus that does not parse as an edge list): retrying
+            # would burn the whole backoff ladder on the same answer
+            # and quarantine a well-formed submit as a misleading
+            # poison_job — fail structured immediately instead, the
+            # same bad_spec discipline admission applies.
+            self._fail_batch([job], structured_error(
+                "bad_spec",
+                f"plan execution rejected the corpus: {e}",
+            ))
+        except Exception as e:  # noqa: BLE001 - retry ladder absorbs it
+            logger.exception("serve plan dispatch failed")
+            self._retry_or_fail(
+                [job], corpora, f"plan: {type(e).__name__}: {e}"
+            )
 
     def _dispatch_remote(
         self, worker, jobs: list[Job], corpora: dict
@@ -1484,7 +1561,19 @@ class ServeDaemon:
                 dropped += 1
                 continue
             try:
-                if rec["workload"] not in WORKLOADS:
+                plan_json = None
+                if rec.get("plan") is not None:
+                    # Plan jobs journal the plan DOCUMENT in the admit
+                    # record: replay re-validates it end-to-end (the
+                    # same gate a fresh submit passes) so a record
+                    # carrying a no-longer-valid plan fails structured
+                    # below, never a dispatch-time surprise.
+                    from locust_tpu.plan import from_doc as plan_from_doc
+
+                    plan_json = plan_from_doc(
+                        rec["plan"]
+                    ).canonical_json()
+                elif rec["workload"] not in WORKLOADS:
                     raise ValueError(f"workload {rec['workload']!r}")
                 overrides = dict(rec.get("config") or {})
                 spec = JobSpec(
@@ -1495,6 +1584,7 @@ class ServeDaemon:
                     no_cache=bool(rec.get("no_cache")),
                     deadline_s=rec.get("deadline_s"),
                     max_attempts=int(rec.get("max_attempts", 4)),
+                    plan=plan_json,
                 )
                 n_lines = int(rec["n_lines"])
                 n_blocks, bucket = batching.job_shape(n_lines, spec.cfg)

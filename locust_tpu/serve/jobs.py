@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import json
 import time
 
 from locust_tpu.config import EngineConfig
+from locust_tpu.plan import PlanError, from_doc as plan_from_doc
 
 # Job lifecycle (reported verbatim by the ``status`` command):
 #   queued -> running -> done | failed;  queued -> cancelled;
@@ -56,6 +58,14 @@ DEADLINE_CAP_S = 3600.0
 WORKLOADS = {
     "wordcount": ("locust_tpu.ops.map_stage:wordcount_map", "sum"),
 }
+
+# Reserved workload name for plan-carrying jobs (docs/PLAN.md): a submit
+# with a ``plan`` document runs an arbitrary compiled pipeline instead of
+# a named WORKLOADS entry, and its executable identity is the PLAN
+# fingerprint (+ config), not a workload string.  Deliberately NOT a
+# WORKLOADS row — there is no single map_fn to resolve; every site that
+# indexes WORKLOADS by name guards on ``spec.plan`` first.
+PLAN_WORKLOAD = "plan"
 
 # Engine-config fields a submit may override; everything else keeps the
 # EngineConfig default.  A closed set so a typo'd knob is a loud
@@ -99,14 +109,41 @@ class JobSpec:
     # ``fingerprint()``: budgets do not change the executable.
     deadline_s: float | None = None
     max_attempts: int = 4
+    # Composable dataflow plan (docs/PLAN.md): the CANONICAL plan JSON
+    # (``Plan.canonical_json()``, validated by parse_spec) for plan
+    # jobs, None for named workloads.  A string, not a Plan: the frozen
+    # spec stays hashable and journal-serializable, and the fingerprint
+    # below hashes exactly these bytes.
+    plan: str | None = None
+
+    def plan_fingerprint(self) -> str | None:
+        """The plan's content address — sha1 of the canonical JSON,
+        identical by construction to ``Plan.fingerprint()`` (the spec
+        stores canonical text, so no re-parse is needed)."""
+        if self.plan is None:
+            return None
+        fp = self.__dict__.get("_plan_fp")
+        if fp is None:
+            fp = hashlib.sha1(self.plan.encode()).hexdigest()[:12]
+            object.__setattr__(self, "_plan_fp", fp)
+        return fp
 
     def fingerprint(self) -> str:
         # Memoized like EngineConfig.fingerprint(): the daemon asks at
         # submit, dispatch, demux and invalidate, and the spec is frozen.
         fp = self.__dict__.get("_fingerprint")
         if fp is None:
-            combine = WORKLOADS[self.workload][1]
-            raw = f"{self.workload}:{combine}:{self.cfg.fingerprint()}"
+            if self.plan is not None:
+                # Plan jobs: the executable IS the (plan, config) pair —
+                # the plan fingerprint keys the result cache, warm
+                # cache, shape buckets and batch keys (docs/PLAN.md).
+                raw = (
+                    f"{PLAN_WORKLOAD}:{self.plan_fingerprint()}:"
+                    f"{self.cfg.fingerprint()}"
+                )
+            else:
+                combine = WORKLOADS[self.workload][1]
+                raw = f"{self.workload}:{combine}:{self.cfg.fingerprint()}"
             fp = hashlib.sha1(raw.encode()).hexdigest()[:12]
             object.__setattr__(self, "_fingerprint", fp)
         return fp
@@ -124,12 +161,50 @@ def parse_spec(
     naming a huge server-side file OOMs the daemon ahead of the
     rejection (inline corpus_b64 is already bounded by the frame cap).
     """
-    workload = req.get("workload", "wordcount")
-    if workload not in WORKLOADS:
-        raise ValueError(
-            f"unknown_workload\nworkload {workload!r} not in "
-            f"{sorted(WORKLOADS)}"
-        )
+    plan_json = None
+    raw_plan = req.get("plan")
+    if raw_plan is not None:
+        # A plan submit: validate the document end-to-end (structure,
+        # registry membership, arity, cycles, dataflow types) BEFORE
+        # anything is admitted — every malformation is a structured
+        # bad_spec, never a dispatch-time surprise (docs/PLAN.md).
+        if isinstance(raw_plan, str):
+            try:
+                raw_plan = json.loads(raw_plan)
+            except ValueError as e:
+                raise ValueError(f"bad_spec\nplan JSON does not parse: {e}")
+        try:
+            p = plan_from_doc(raw_plan)
+        except PlanError as e:
+            raise ValueError(f"bad_spec\ninvalid plan: {e}")
+        # A serve submit carries ONE corpus: a plan whose sources name
+        # distinct inputs would feed the same bytes to every source — a
+        # silent self-join, the wrong answer this tier forbids.  Reject
+        # at admission (run_corpus carries the dispatch-side defense).
+        named = sorted({
+            n.param("input", "corpus")
+            for n in p.nodes if n.kind == "source"
+        } - {"corpus"})
+        if named:
+            raise ValueError(
+                f"bad_spec\nplan sources name inputs {named}, but a "
+                "submit carries exactly one corpus (name every source "
+                "input 'corpus' or split the pipeline)"
+            )
+        plan_json = p.canonical_json()
+        if req.get("workload") not in (None, PLAN_WORKLOAD):
+            raise ValueError(
+                "bad_spec\nsubmit takes a plan OR a workload name, "
+                "not both"
+            )
+        workload = PLAN_WORKLOAD
+    else:
+        workload = req.get("workload", "wordcount")
+        if workload not in WORKLOADS:
+            raise ValueError(
+                f"unknown_workload\nworkload {workload!r} not in "
+                f"{sorted(WORKLOADS)}"
+            )
     corpus_b64 = req.get("corpus_b64")
     path = req.get("path")
     if (corpus_b64 is None) == (path is None):
@@ -207,6 +282,7 @@ def parse_spec(
         no_cache=bool(req.get("no_cache")),
         deadline_s=deadline_s,
         max_attempts=max_attempts,
+        plan=plan_json,
     )
     return spec, corpus
 

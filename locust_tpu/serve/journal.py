@@ -72,7 +72,7 @@ def admit_record(job) -> dict:
     daemon's compaction (which rebuilds live records from its in-memory
     jobs) — two spellings of the record would drift."""
     spec = job.spec
-    return {
+    rec = {
         "rec": "admit",
         "v": JOURNAL_VERSION,
         "job_id": job.job_id,
@@ -87,6 +87,15 @@ def admit_record(job) -> dict:
         "n_lines": job.n_lines,
         "t": time.time(),
     }
+    if spec.plan is not None:
+        # Plan jobs journal the WHOLE plan document (docs/PLAN.md): the
+        # WAL is what makes the accept ack a durable promise, and for an
+        # arbitrary pipeline the plan IS the half of the work the corpus
+        # spill does not carry — replay re-validates and re-executes it
+        # under the original id.  Additive: pre-plan records simply lack
+        # the key and replay exactly as before.
+        rec["plan"] = json.loads(spec.plan)
+    return rec
 
 
 class JournalEntry:

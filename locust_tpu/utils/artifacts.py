@@ -67,6 +67,7 @@ BENCH_SUBDICT_KINDS = {
     "dataplane": "dataplane_bench",
     "serve": "serve_bench",
     "recovery": "recovery_bench",
+    "plan": "plan_bench",
 }
 
 

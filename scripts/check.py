@@ -3,7 +3,7 @@
 
     env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
 
-Runs (1) the two-phase invariant checker (R001-R012) over the configured
+Runs (1) the two-phase invariant checker (R001-R014) over the configured
 paths (exit 1 on new findings — docs/ANALYSIS.md) including a SARIF
 emission round-trip, (2) tests/test_analysis.py, which includes the
 repo-wide gate test, and (3) a small traced engine run whose exported
@@ -104,14 +104,26 @@ def main(argv=None) -> int:
         [sys.executable, "-c", _POOL_SMOKE], cwd=REPO, env=env,
         timeout=420,
     ).returncode
+
+    # Plan smoke (docs/PLAN.md): a two-stage tf-idf PLAN submitted to a
+    # real daemon must answer byte-identically to the one-shot
+    # `python -m locust_tpu tfidf` CLI over the same corpus, and a
+    # repeat must be a result-cache hit keyed by the plan fingerprint.
+    # The recovery smoke above additionally SIGKILLs a daemon holding a
+    # journaled plan job and diffs its replay the same way.
+    plan_rc = subprocess.run(
+        [sys.executable, "-c", _PLAN_SMOKE], cwd=REPO, env=env,
+        timeout=300,
+    ).returncode
     print(
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
         f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
-        f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}",
+        f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}; "
+        f"plan smoke rc={plan_rc}",
         file=sys.stderr,
     )
     return (rc or proc.returncode or trace_rc or serve_rc
-            or recovery_rc or pool_rc)
+            or recovery_rc or pool_rc or plan_rc)
 
 
 _TRACE_ROUNDTRIP = """
@@ -183,13 +195,20 @@ cfg_flags = ["--block-lines", "8", "--line-width", "64",
 env = {**os.environ, "JAX_PLATFORMS": "cpu",
        "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "recovery-smoke"}
 
-# The oracle: the one-shot CLI over the same corpus + caps.
+# The oracles: the one-shot CLI over the same corpus + caps, for the
+# WordCount job AND the two-stage tf-idf PLAN job (docs/PLAN.md).
 one_shot = subprocess.run(
     [sys.executable, "-m", "locust_tpu", corpus_path,
      "--backend", "cpu", "--no-timing"] + cfg_flags,
     env=env, capture_output=True, timeout=240,
 )
 assert one_shot.returncode == 0, one_shot.stderr[-800:]
+tfidf_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", "tfidf", corpus_path,
+     "--backend", "cpu", "--lines-per-doc", "2"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert tfidf_shot.returncode == 0, tfidf_shot.stderr[-800:]
 
 def spawn(env=env):
     proc = subprocess.Popen(
@@ -202,6 +221,7 @@ def spawn(env=env):
     host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
     return proc, (host, int(port))
 
+from locust_tpu.plan import tfidf_plan
 from locust_tpu.serve.client import ServeClient
 
 proc, addr = spawn()
@@ -209,10 +229,17 @@ try:
     client = ServeClient(addr, b"recovery-smoke", timeout=30.0)
     cfgov = {"block_lines": 8, "line_width": 64, "key_width": 16,
              "emits_per_line": 8}
-    job_id = client.submit(corpus=open(corpus_path, "rb").read(),
-                           config=cfgov, no_cache=True)["job_id"]
-    # SIGKILL right behind the ack: the job is queued-or-mid-dispatch,
-    # exactly the lost-work window the journal closes.
+    corpus = open(corpus_path, "rb").read()
+    job_id = client.submit(corpus=corpus, config=cfgov,
+                           no_cache=True)["job_id"]
+    # A journaled PLAN job rides the same crash: the WAL admit record
+    # carries the whole plan document, so the restart must re-execute
+    # the arbitrary pipeline under its original id (docs/PLAN.md).
+    plan_id = client.submit(corpus=corpus, config=cfgov,
+                            plan=tfidf_plan(2).to_doc(),
+                            no_cache=True)["job_id"]
+    # SIGKILL right behind the acks: the jobs are queued-or-mid-
+    # dispatch, exactly the lost-work window the journal closes.
     proc.send_signal(signal.SIGKILL)
     proc.wait(timeout=10)
 finally:
@@ -230,13 +257,20 @@ try:
         "replayed result != one-shot CLI\\n%r\\n%r"
         % (got[:200], one_shot.stdout[:200])
     )
+    pres = c2.wait(plan_id, timeout=240.0)
+    assert pres.get("plan") is True, pres.get("plan")
+    assert pres["pairs"][0][0] == tfidf_shot.stdout, (
+        "replayed plan result != one-shot tfidf CLI\\n%r\\n%r"
+        % (pres["pairs"][0][0][:200], tfidf_shot.stdout[:200])
+    )
     c2.shutdown()
     proc2.wait(timeout=30)
 finally:
     if proc2.poll() is None:
         proc2.kill()
-print("[check] recovery smoke ok (SIGKILL mid-job -> replay "
-      "byte-identical to the one-shot CLI)", file=sys.stderr)
+print("[check] recovery smoke ok (SIGKILL mid-job -> wordcount AND "
+      "plan replays byte-identical to the one-shot CLI)",
+      file=sys.stderr)
 """
 
 
@@ -339,6 +373,74 @@ finally:
             p.kill()
 print("[check] pool smoke ok (2 real workers; SIGKILL mid-serve-batch "
       "-> retried result byte-identical to the one-shot CLI)",
+      file=sys.stderr)
+"""
+
+
+_PLAN_SMOKE = """
+import json, os, subprocess, sys, tempfile
+
+td = tempfile.mkdtemp(prefix="locust_plan_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write(b"alpha beta gamma\\nbeta gamma delta\\nalpha alpha\\n"
+            b"epsilon zeta\\n" * 4)
+cfg_flags = ["--block-lines", "8", "--line-width", "64",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "plan-smoke"}
+
+# The oracle: the one-shot hand-wired tfidf CLI over the same corpus.
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", "tfidf", corpus_path,
+     "--backend", "cpu", "--lines-per-doc", "2"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+
+# The same pipeline as a PLAN document, submitted through the serve CLI
+# (`submit FILE --plan PLAN.json`) against a real daemon.
+from locust_tpu.plan import tfidf_plan
+
+plan_path = os.path.join(td, "tfidf_plan.json")
+with open(plan_path, "w") as f:
+    json.dump(tfidf_plan(2).to_doc(), f)
+
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "locust_tpu.serve", "--port", "0"],
+    env=env, stderr=subprocess.PIPE, text=True,
+)
+try:
+    line = daemon.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    submit = [sys.executable, "-m", "locust_tpu.serve", "submit",
+              corpus_path, "--plan", plan_path, "--port", port] + cfg_flags
+    cold = subprocess.run(submit, env=env, capture_output=True,
+                          timeout=240)
+    assert cold.returncode == 0, cold.stderr[-800:]
+    assert cold.stdout == one_shot.stdout, (
+        "plan submit != one-shot tfidf CLI\\n%r\\n%r"
+        % (cold.stdout[:200], one_shot.stdout[:200])
+    )
+    # Repeat: a result-cache hit keyed by the plan fingerprint, still
+    # byte-identical.
+    warm = subprocess.run(submit, env=env, capture_output=True,
+                          timeout=240)
+    assert warm.returncode == 0, warm.stderr[-800:]
+    assert warm.stdout == one_shot.stdout
+    assert b"(cached)" in warm.stderr, warm.stderr[-400:]
+    subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "shutdown",
+         "--port", port],
+        env=env, capture_output=True, timeout=60,
+    )
+    daemon.wait(timeout=30)
+finally:
+    if daemon.poll() is None:
+        daemon.kill()
+print("[check] plan smoke ok (two-stage tfidf plan byte-identical to "
+      "the one-shot CLI, repeat = plan-keyed result-cache hit)",
       file=sys.stderr)
 """
 

@@ -616,6 +616,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/serve/journal.py",  # emits serve.journal_ms
         "locust_tpu/serve/pool.py",     # emits serve.place/affinity_hits
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
+        "locust_tpu/plan/compile.py",   # emits plan.compile/plan.run
     ):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)
@@ -1257,13 +1258,182 @@ def test_r013_reason_noqa_suppresses(tmp_path):
     assert not res.new and res.suppressed == 1
 
 
+# ------------------------------------------------------------------- R014
+
+_FIXTURE_PLAN_NODES = """
+    NODE_KINDS = (
+        "source",
+        "sink",
+    )
+
+    def node(node_id, kind, op, inputs=(), **params):
+        return (node_id, kind, op, tuple(inputs), tuple(params.items()))
+"""
+
+
+def _r014_tree(tmp_path, compile_src=None, nodes=_FIXTURE_PLAN_NODES,
+               docs_text=None, tests_text=None):
+    _write(tmp_path, "locust_tpu/plan/nodes.py", nodes)
+    _write(
+        tmp_path, "locust_tpu/plan/compile.py",
+        compile_src if compile_src is not None else """
+        def lower(n):
+            if n.kind == "source":
+                return "stage-source"
+            if n.kind == "sink":
+                return "stage-sink"
+            raise ValueError(n.kind)
+    """)
+    _write(tmp_path, "tests/test_plan.py",
+           tests_text if tests_text is not None
+           else '# exercises "source" and "sink"\n')
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "PLAN.md").write_text(
+        docs_text if docs_text is not None
+        else "| `source` | ... |\n| `sink` | ... |\n"
+    )
+
+
+def test_r014_silent_when_registry_compiler_docs_tests_agree(tmp_path):
+    _r014_tree(tmp_path)
+    assert not _run(tmp_path, ["R014"], ["locust_tpu", "tests"]).new
+
+
+def test_r014_fires_on_unregistered_kind_at_construction_site(tmp_path):
+    # A typo'd kind in a node(...) construction anywhere in locust_tpu/.
+    _write(tmp_path, "locust_tpu/builders.py", """
+        from locust_tpu.plan.nodes import node
+
+        def broken_plan():
+            return [node("a", "sorce", "text")]
+    """)
+    _r014_tree(tmp_path)
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "sorce" in msgs and "not in" in msgs and "NODE_KINDS" in msgs
+
+
+def test_r014_fires_on_unregistered_kind_match_in_plan_layer(tmp_path):
+    # A matcher arm for an unregistered kind inside locust_tpu/plan/.
+    _r014_tree(tmp_path, compile_src="""
+        def lower(n):
+            if n.kind == "source":
+                return "stage-source"
+            if n.kind == "sink":
+                return "stage-sink"
+            if n.kind == "window":
+                return "stage-window"
+            raise ValueError(n.kind)
+    """)
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "window" in msgs and "NODE_KINDS" in msgs
+
+
+def test_r014_kind_match_outside_plan_layer_not_attributed(tmp_path):
+    # Attribution discipline: `.kind` is a common attribute name — a
+    # comparison in a NON-plan module (the analyzer's own thread
+    # summaries use s.kind == "thread") must not be claimed as a plan
+    # kind.  Construction calls stay checked repo-wide.
+    _write(tmp_path, "locust_tpu/other.py", """
+        def classify(s):
+            return s.kind == "thread"
+    """)
+    _r014_tree(tmp_path)
+    assert not _run(tmp_path, ["R014"], ["locust_tpu", "tests"]).new
+
+
+def test_r014_fires_on_uncompiled_untested_undocumented_kind(tmp_path):
+    _r014_tree(
+        tmp_path,
+        nodes=_FIXTURE_PLAN_NODES.replace(
+            '"source",', '"source",\n        "window",'
+        ),
+    )
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "never lowered" in msgs
+    assert "never exercised" in msgs
+    assert "undocumented" in msgs
+    assert all("window" in f.message for f in res.new)
+    assert len(res.new) == 3
+
+
+def test_r014_analyzer_suite_quotes_do_not_count_as_coverage(tmp_path):
+    """A kind quoted ONLY in tests/test_analysis.py (the rule's own
+    fixtures quote phantom kinds to test the RULE) must still fire
+    'never exercised' — otherwise a real future kind named after a
+    fixture literal would read as covered forever (review finding)."""
+    _r014_tree(
+        tmp_path,
+        nodes=_FIXTURE_PLAN_NODES.replace(
+            '"source",', '"source",\n        "window",'
+        ),
+        compile_src="""
+        def lower(n):
+            if n.kind == "source":
+                return "s"
+            if n.kind == "sink":
+                return "k"
+            if n.kind == "window":
+                return "w"
+            raise ValueError(n.kind)
+    """,
+        docs_text="| `source` | `sink` | `window` |\n",
+    )
+    _write(tmp_path, "tests/test_analysis.py",
+           '# quotes "window" in a rule fixture, not a plan test\n')
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "never exercised" in res.new[0].message
+    assert "window" in res.new[0].message
+
+
+def test_r014_missing_registry_reports_once(tmp_path):
+    _r014_tree(tmp_path, nodes="KINDS = ()\n")
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "cannot parse the NODE_KINDS registry" in res.new[0].message
+
+
+def test_r014_mutating_real_node_kinds_fails_the_gate(tmp_path):
+    """R004/R011-style acceptance demo on the REAL plan layer: copy the
+    registry + compiler + suite + docs, register one phantom kind — the
+    gate must fail with exactly the unlowered/untested/undocumented
+    findings for it (the drift ROADMAP item 4's new operators would
+    otherwise introduce, machine-checked)."""
+    for rel in (
+        "locust_tpu/plan/nodes.py",
+        "locust_tpu/plan/compile.py",
+        "locust_tpu/plan/builders.py",
+        "tests/test_plan.py",
+        "docs/PLAN.md",
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    paths = ["locust_tpu", "tests"]
+    assert not _run(tmp_path, ["R014"], paths).new  # faithful copy: green
+
+    np_ = tmp_path / "locust_tpu/plan/nodes.py"
+    mutated = np_.read_text().replace(
+        'NODE_KINDS = (\n    "source",',
+        'NODE_KINDS = (\n    "window",\n    "source",', 1,
+    )
+    assert '"window"' in mutated
+    np_.write_text(mutated)
+    res = _run(tmp_path, ["R014"], paths)
+    assert len(res.new) == 3  # unlowered + untested + undocumented
+    assert all("window" in f.message for f in res.new)
+
+
 # ------------------------------------------------------- registry + CLI
 
 
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
-        "R009", "R010", "R011", "R012", "R013",
+        "R009", "R010", "R011", "R012", "R013", "R014",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])

@@ -1444,3 +1444,48 @@ def test_chaos_serve_pool_worker_death_mid_batch_recovers_exact():
         daemon.close()
         for w in workers[1:]:
             _shutdown(w)
+
+
+def test_chaos_serve_journal_plan_job_replays_byte_identical(tmp_path):
+    """Chaos-matrix row for PLAN jobs (docs/PLAN.md): an admitted plan
+    job — the WAL admit record carries the whole plan document — is
+    SIGKILL'd mid-dispatch (serve.dispatch delay holds it in flight)
+    and must replay byte-identically under its ORIGINAL id after a
+    restart on the same journal, exactly like a named-workload job."""
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.plan.compile import compile_plan
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+
+    daemon, client = _journal_rig(tmp_path)
+    abandoned = False
+    plan_doc = tfidf_plan(2).to_doc()
+    try:
+        p = plan([{"site": "serve.dispatch", "action": "delay",
+                   "delay_s": 30.0, "times": 1}])
+        with faultplan.active_plan(p):
+            ack = client.submit(
+                corpus=SERVE_CORPUS, config=SERVE_CFG,
+                plan=plan_doc, no_cache=True,
+            )
+            _abandon(daemon)
+            abandoned = True
+    finally:
+        if not abandoned:
+            daemon.close()
+    d2 = ServeDaemon(
+        secret=SECRET,
+        cfg=ServeConfig(journal_dir=str(tmp_path / "journal"),
+                        dispatch_poll_s=0.02),
+    )
+    d2.serve_in_thread()
+    c2 = ServeClient(d2.addr, SECRET, timeout=30.0)
+    try:
+        res = c2.wait(ack["job_id"], timeout=120.0)
+        assert res["plan"] is True
+        oracle = compile_plan(
+            tfidf_plan(2), EngineConfig(**SERVE_CFG)
+        ).run_corpus(SERVE_CORPUS).output
+        assert res["pairs"][0][0] == oracle
+    finally:
+        d2.close()

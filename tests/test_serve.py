@@ -1640,3 +1640,181 @@ def test_pool_close_stops_placements_and_executor():
     assert daemon.pool.place((("wordcount", "fp"), 1)) is None
     with pytest.raises(RuntimeError):
         daemon.pool.submit(lambda: None)
+
+
+# --------------------------------------------------------------- plan jobs
+
+
+def _tfidf_plan_doc():
+    from locust_tpu.plan import tfidf_plan
+
+    return tfidf_plan(2).to_doc()
+
+
+def _plan_oracle(corpus: bytes) -> bytes:
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.plan.compile import compile_plan
+
+    return compile_plan(tfidf_plan(2), CFG).run_corpus(corpus).output
+
+
+def test_daemon_plan_submit_roundtrip(rig):
+    """A plan submit answers the pipeline's sink-rendered output as ONE
+    (bytes, 0) pair flagged ``plan`` — byte-identical to the locally
+    compiled plan over the same corpus (docs/PLAN.md)."""
+    _, client = rig
+    ack = client.submit(
+        corpus=CORPUS_A, config=CFG_OVR, plan=_tfidf_plan_doc()
+    )
+    assert ack["state"] == "queued" and not ack["cached"]
+    res = client.wait(ack["job_id"], timeout=120.0)
+    assert res["plan"] is True
+    assert len(res["pairs"]) == 1 and res["pairs"][0][1] == 0
+    assert res["pairs"][0][0] == _plan_oracle(CORPUS_A)
+    st = client.status(ack["job_id"])
+    assert st["workload"] == "plan" and st["placed_on"] == "local"
+
+
+def test_daemon_plan_repeat_hits_result_cache_by_plan_fingerprint(rig):
+    """The result cache keys off the PLAN fingerprint: a repeat of the
+    same (plan, config, corpus) answers at admission; a DIFFERENT plan
+    over the same corpus+config recomputes."""
+    _, client = rig
+    a1 = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                       plan=_tfidf_plan_doc())
+    client.wait(a1["job_id"], timeout=120.0)
+    a2 = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                       plan=_tfidf_plan_doc())
+    assert a2["cached"] is True
+    res = client.result(a2["job_id"])
+    assert res["plan"] is True
+    assert res["pairs"][0][0] == _plan_oracle(CORPUS_A)
+    # A different lines_per_doc is a different plan fingerprint: miss.
+    from locust_tpu.plan import tfidf_plan
+
+    a3 = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                       plan=tfidf_plan(3).to_doc())
+    assert a3["cached"] is False
+    client.wait(a3["job_id"], timeout=120.0)
+
+
+def test_daemon_plan_repeat_new_bytes_is_warm_executable_hit(rig):
+    """Same plan over NEW bytes skips lowering: the warm-executable
+    cache holds the CompiledPlan keyed by (plan fp, cfg fp, bucket) and
+    the repeat reports cache='warm' with compiles unchanged."""
+    daemon, client = rig
+    a1 = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                       plan=_tfidf_plan_doc(), no_cache=True)
+    client.wait(a1["job_id"], timeout=120.0)
+    compiles = daemon.executables.stats()["compiles"]
+    corpus2 = CORPUS_A.replace(b"alpha", b"omega")
+    a2 = client.submit(corpus=corpus2, config=CFG_OVR,
+                       plan=_tfidf_plan_doc(), no_cache=True)
+    res = client.wait(a2["job_id"], timeout=120.0)
+    assert res["cache"] == "warm"
+    assert daemon.executables.stats()["compiles"] == compiles
+    assert res["pairs"][0][0] == _plan_oracle(corpus2)
+
+
+def test_daemon_plan_bad_spec_is_structured(rig):
+    _, client = rig
+    with pytest.raises(ServeError) as e:
+        client.submit(corpus=CORPUS_A, plan={
+            "plan_version": 1,
+            "nodes": [{"id": "a", "kind": "window", "op": "text"}],
+        })
+    assert e.value.code == "bad_spec"
+    assert "unknown kind" in str(e.value)
+    # The client mirrors the daemon rule instead of silently dropping a
+    # conflicting workload (review finding).
+    with pytest.raises(ValueError, match="not both"):
+        client.submit(corpus=CORPUS_A, workload="other",
+                      plan=_tfidf_plan_doc())
+    # The client API sends plan OR workload; a raw peer naming both is
+    # still rejected structured at parse_spec.
+    import base64
+
+    resp = client.rpc({
+        "cmd": "submit", "workload": "wordcount",
+        "plan": _tfidf_plan_doc(),
+        "corpus_b64": base64.b64encode(CORPUS_A).decode(),
+    })
+    assert resp["status"] == "error" and resp["code"] == "bad_spec"
+
+
+def test_daemon_plan_jobs_never_coalesce_or_shard(rig):
+    daemon, _ = rig
+    from locust_tpu.serve.jobs import JobSpec, PLAN_WORKLOAD
+    from locust_tpu.plan import tfidf_plan
+
+    spec = JobSpec(tenant="t", workload=PLAN_WORKLOAD, cfg=CFG,
+                   plan=tfidf_plan(2).canonical_json())
+    job = Job(job_id="p1", spec=spec, corpus_digest="d", n_lines=999,
+              n_blocks=256, bucket=256)
+    other = Job(job_id="p2", spec=spec, corpus_digest="d", n_lines=999,
+                n_blocks=256, bucket=256)
+    assert daemon._batch_key(job) != daemon._batch_key(other)  # solo
+    assert not daemon._shardable(job)  # plan jobs stay local
+    # and the engine key folds the plan fingerprint in
+    key = ExecutableCache.engine_key(spec)
+    assert spec.plan_fingerprint() in key
+
+
+def test_daemon_plan_deterministic_error_fails_structured_not_poison(rig):
+    """A pagerank plan over a corpus that does not parse as an edge
+    list is a DETERMINISTIC rejection: it must answer structured
+    bad_spec on the first dispatch, not burn the retry ladder and end
+    as a misleading poison_job (review finding)."""
+    from locust_tpu.plan import pagerank_plan
+
+    _, client = rig
+    ack = client.submit(
+        corpus=b"alpha beta gamma\nnot an edge list\n",
+        plan=pagerank_plan(3).to_doc(),
+    )
+    with pytest.raises(ServeError) as e:
+        client.wait(ack["job_id"], timeout=60.0)
+    assert e.value.code == "bad_spec"
+    assert "edge list" in str(e.value)
+    st = client.status(ack["job_id"])
+    assert st["state"] == "failed"
+    assert st["attempts"] == 0  # never entered the retry ladder
+    # Corpus-derived dense state is bounded on the serve path: a tiny
+    # edge list naming a huge node id rejects structured, never an OOM.
+    a2 = client.submit(corpus=b"0 2000000000\n",
+                       plan=pagerank_plan(3).to_doc())
+    with pytest.raises(ServeError) as e:
+        client.wait(a2["job_id"], timeout=60.0)
+    assert e.value.code == "bad_spec"
+    assert "cap" in str(e.value)
+
+
+def test_daemon_plan_job_replays_from_journal(tmp_path):
+    """Durability: a journaled plan job SIGKILL'd mid-dispatch replays
+    under its original id after restart, byte-identical (the WAL admit
+    record carries the whole plan document)."""
+    from locust_tpu.utils import faultplan
+
+    jd = str(tmp_path / "journal")
+    daemon = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=jd, dispatch_poll_s=0.02))
+    daemon.serve_in_thread()
+    client = ServeClient(daemon.addr, SECRET, timeout=60.0)
+    p = faultplan.FaultPlan(
+        [{"site": "serve.dispatch", "action": "delay",
+          "delay_s": 30.0, "times": 1}], seed=3,
+    )
+    with faultplan.active_plan(p):
+        ack = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                            plan=_tfidf_plan_doc(), no_cache=True)
+        serve_abandon(daemon)
+    d2 = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=jd, dispatch_poll_s=0.02))
+    d2.serve_in_thread()
+    c2 = ServeClient(d2.addr, SECRET, timeout=60.0)
+    try:
+        res = c2.wait(ack["job_id"], timeout=120.0)
+        assert res["plan"] is True
+        assert res["pairs"][0][0] == _plan_oracle(CORPUS_A)
+    finally:
+        d2.close()
