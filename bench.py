@@ -1330,6 +1330,8 @@ def run_bench(backend: str) -> dict:
         n_blocks,
         best,
         jax.devices()[0].device_kind,
+        block_lines=cfg.block_lines,
+        line_width=cfg.line_width,
     )
     util = roof["hbm_utilization_pct"]
     print(

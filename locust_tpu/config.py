@@ -27,16 +27,21 @@ DELIMITERS: bytes = b" ,.-;:'()\"\t"
 # ops.process_stage.sort_and_compact dispatch all key off this.
 SORT_MODES = (
     "hash", "hashp", "hashp2", "hashp1", "hash1", "radix", "bitonic", "lex",
-    "hasht", "hasht-mxu",
+    "hasht", "hasht-mxu", "fused",
 )
 
 # The sort-FREE fold family (ops/hash_table.py): identical probe/exactness
 # ladder, differing only in how the value-combine scatter is spelled —
 # "hasht" = XLA duplicate-index scatter, "hasht-mxu" = one-hot bf16
-# contraction on the MXU (hash_table.mxu_scatter_add).  Every site that
-# used to test ``sort_mode == "hasht"`` must test membership here instead;
-# the two modes share slot-ordered (non prefix-compact) table semantics.
-HASHT_FAMILY = ("hasht", "hasht-mxu")
+# contraction on the MXU (hash_table.mxu_scatter_add), "fused" = hasht
+# semantics everywhere PLUS the Pallas map->aggregate megakernel
+# (ops/pallas/fused_fold.py) at the single-device line->fold boundary,
+# which pre-aggregates each block in VMEM so the [lines, emits, key_width]
+# token tensor never round-trips HBM.  Every site that used to test
+# ``sort_mode == "hasht"`` must test membership here instead; the three
+# modes share slot-ordered (non prefix-compact) table semantics and
+# bit-identical tables (tests/test_hasht_mxu.py, tests/test_fused_fold.py).
+HASHT_FAMILY = ("hasht", "hasht-mxu", "fused")
 
 
 def default_sort_mode(backend: str) -> str:
@@ -195,6 +200,109 @@ def hasht_mxu_grid(table_size: int) -> tuple[int, int]:
     t_hi = -(-table_size // t_lo)
     return t_hi, t_lo
 
+
+# --- fused map->aggregate megakernel knobs (ops/pallas/fused_fold.py) ---
+# jax-free HERE so utils/roofline.py prices the kernel's HBM bytes off the
+# SAME validated values the kernel runs with (the hasht-mxu precedent: a
+# drifted copy would silently model the wrong traffic).
+
+# Lines per kernel grid step.  uint8 VMEM tiles are (32, 128), so the tile
+# must be a multiple of 32; each step's within-tile dedupe builds a
+# [tile*emits_per_line]^2 Gram matrix in VMEM, which is what keeps the
+# default small (32 lines x 20 emits = a 640^2 f32 Gram, ~1.6 MB).
+FUSED_TILE_LINES: int = int(_os.environ.get("LOCUST_FUSED_TILE_LINES", 32))
+if FUSED_TILE_LINES < 32 or FUSED_TILE_LINES % 32 != 0:
+    raise ValueError(
+        f"LOCUST_FUSED_TILE_LINES must be a positive multiple of 32 "
+        f"(uint8 sublane tile), got {FUSED_TILE_LINES}"
+    )
+
+# VMEM-resident kernel table slots (per BLOCK, rebuilt every fold): bounds
+# the distinct keys one block can pre-aggregate in VMEM; keys past it
+# strand to the residual stream (and a residual overflow falls the whole
+# block back to the stock hasht fold — exact either way).  Power of two so
+# the in-kernel ``h % slots`` is a bitwise AND.  8192 slots x (key bytes +
+# occupied + count) f32 planes ~ 1.2 MB VMEM at key_width 32.
+FUSED_TABLE_SLOTS: int = int(_os.environ.get("LOCUST_FUSED_TABLE_SLOTS", 8192))
+if FUSED_TABLE_SLOTS < 512 or FUSED_TABLE_SLOTS & (FUSED_TABLE_SLOTS - 1):
+    raise ValueError(
+        f"LOCUST_FUSED_TABLE_SLOTS must be a power of two >= 512, "
+        f"got {FUSED_TABLE_SLOTS}"
+    )
+
+# Residual rows per grid tile: per-tile distinct keys the probe rounds
+# strand (table collision/full) stream out through this bounded buffer;
+# more than this per tile sets the kernel's overflow flag and the engine
+# re-folds the block through the stock path.  Power of two.
+FUSED_RESIDUAL_ROWS: int = int(
+    _os.environ.get("LOCUST_FUSED_RESIDUAL_ROWS", 32)
+)
+if FUSED_RESIDUAL_ROWS < 8 or FUSED_RESIDUAL_ROWS & (FUSED_RESIDUAL_ROWS - 1):
+    raise ValueError(
+        f"LOCUST_FUSED_RESIDUAL_ROWS must be a power of two >= 8, "
+        f"got {FUSED_RESIDUAL_ROWS}"
+    )
+
+# Residual row padding lanes beyond the key bytes (count + valid flag +
+# zero tail): the kernel's residual rows are (key_width + FUSED_RESID_PAD)
+# f32 lanes wide, and those rows DO cross HBM — utils/roofline.py prices
+# exactly this width off this constant.
+FUSED_RESID_PAD: int = 8
+
+# Off-TPU the kernel runs in interpret mode (the pinned test vehicle —
+# NEVER inside a full CPU mesh program, CLAUDE.md); the interpreter
+# re-traces the kernel body per grid step, so production block sizes cost
+# minutes of XLA CPU compile.  Blocks with more lines than this take the
+# hasht-identical stock path off-TPU with a one-time notice — the same
+# stance as BITONIC_INTERPRET_MAX.  On TPU the Mosaic kernel always runs.
+FUSED_INTERPRET_MAX_LINES: int = int(
+    _os.environ.get("LOCUST_FUSED_INTERPRET_MAX_LINES", 8192)
+)
+if FUSED_INTERPRET_MAX_LINES < 0:
+    raise ValueError(
+        f"LOCUST_FUSED_INTERPRET_MAX_LINES must be >= 0, "
+        f"got {FUSED_INTERPRET_MAX_LINES}"
+    )
+
+
+# f32 sublane tile rows: the kernel stores its table as stacked
+# [t_hi, t_lo] planes and slices them per plane, so the plane stride
+# (t_hi) must stay sublane-aligned for Mosaic; fused_table_layout pads
+# small tables up to this.  Shared here (jax-free) so the kernel and the
+# roofline model read ONE value.
+FUSED_SUBLANE: int = 8
+
+
+def fused_grid(slots: int | None = None) -> tuple[int, int]:
+    """[t_hi, t_lo] LOGICAL decomposition of a ``slots``-slot kernel
+    table's slot axis (default FUSED_TABLE_SLOTS; t_hi * t_lo == slots;
+    slot = hi * t_lo + lo).
+
+    t_lo is fixed at the 512-lane width the MXU histogram measured best
+    (NOT the HASHT_MXU_LANES env knob: the kernel's hi/lo split is
+    shift+mask, so t_lo must stay a power of two).  The ONE place the
+    decomposition is decided: :func:`fused_table_layout` (the physical
+    plane layout) derives from it, so the two can never drift."""
+    s = FUSED_TABLE_SLOTS if slots is None else slots
+    t_lo = min(512, s)
+    t_hi = s // t_lo
+    return t_hi, t_lo
+
+
+def fused_table_layout(slots: int | None = None) -> tuple[int, int]:
+    """[t_hi, t_lo] PHYSICAL plane layout for a ``slots``-slot kernel
+    table (default FUSED_TABLE_SLOTS): the :func:`fused_grid`
+    decomposition with the hi axis padded up to FUSED_SUBLANE so
+    per-plane ref slices stay Mosaic-aligned.  The megakernel allocates
+    its VMEM planes from this and utils/roofline.py prices the table
+    flush off it, so the modeled bytes cannot drift from the table that
+    actually crossed HBM (the hasht_mxu_grid contract).  Padded slots
+    are never addressed (slot ids < slots) and decode as count-0 =
+    invalid."""
+    t_hi, t_lo = fused_grid(slots)
+    return max(FUSED_SUBLANE, t_hi), t_lo
+
+
 BITONIC_TILE_ROWS: int = int(_os.environ.get("LOCUST_BITONIC_TILE_ROWS", 256))
 if BITONIC_TILE_ROWS < 8 or BITONIC_TILE_ROWS & (BITONIC_TILE_ROWS - 1):
     raise ValueError(
@@ -324,7 +432,15 @@ class EngineConfig:
     # duplicate-index scatter — byte-identical tables, armed for the TPU
     # engine-level A/B (the K_mxu_hist primitive measured 52.0 ms vs the
     # J scatter's 107.6 at the fold shape, ledger ts 1785523898).
-    # Variant timings: scripts/bench_sort_variants.py -> artifacts/.
+    # "fused": hasht semantics PLUS the Pallas map->aggregate megakernel
+    # (ops/pallas/fused_fold.py) at the single-device line->fold
+    # boundary — tokenize + hash + table-update in one VMEM-resident
+    # kernel, so the [lines, emits, key_width] token tensor never
+    # round-trips HBM; tables stay BIT-identical to "hasht" (the
+    # settlement fold is hasht's own aggregate_exact).  Off the
+    # wordcount map / off supported shapes / inside mesh programs the
+    # mode degrades to "hasht" exactly.  Variant timings:
+    # scripts/bench_sort_variants.py -> artifacts/.
     sort_mode: str = "hash"
 
     # Overflow behavior for > emits_per_line tokens: the reference prints

@@ -265,7 +265,10 @@ class MapReduceEngine:
         self.combine = combine  # user-facing semantics (host finalize)
         # "count" lowers to emit-1 + sum so the block-accumulator merge is
         # associative (reduce_stage.normalize_combine); the device pipeline
-        # below uses the normalized pair throughout.
+        # below uses the normalized pair throughout.  The RAW map_fn is
+        # what the fused-kernel eligibility check identifies (the count
+        # wrapper emits the same 1s the kernel counts).
+        raw_map_fn = map_fn
         map_fn, combine = normalize_combine(map_fn, combine)
         self.map_fn = map_fn
         tsize = cfg.resolved_table_size
@@ -273,18 +276,86 @@ class MapReduceEngine:
 
         from locust_tpu.ops.hash_table import fold_into
 
+        # sort_mode="fused": the Pallas map->aggregate megakernel
+        # (ops/pallas/fused_fold.py) replaces the map stage + first
+        # aggregation at THIS boundary only — everywhere else the mode
+        # is "hasht" exactly (config.HASHT_FAMILY).  Eligibility is
+        # fully static, decided (and logged) once here, never inside
+        # traced code.
+        self._fused_kernel_on = False
+        if mode == "fused":
+            from locust_tpu.ops.pallas.fused_fold import (
+                fused_engine_eligible,
+            )
+
+            ok, why = fused_engine_eligible(cfg, raw_map_fn, self.combine)
+            self._fused_kernel_on = ok
+            if not ok:
+                logger.info("sort_mode='fused': kernel not engaged — %s",
+                            why)
+
         def fold_block(acc: KVBatch, lines: jax.Array):
             """Map one block and merge its emits into the running table.
 
             Sort modes: ONE sort of (table_size + emits_per_block) rows
             does both the block's shuffle-grouping and the cross-block
             merge.  The hasht family ("hasht" = scatter combine,
-            "hasht-mxu" = one-hot MXU combine): the sort-free fold with
-            its exactness ladder, rebuilt per fold
-            (ops/hash_table.fold_into — see there for why the
-            incremental variant measured worse and is not wired).
-            Either way the running distinct-key count is measured BEFORE
-            the capacity slice so a truncation in any fold is observable.
+            "hasht-mxu" = one-hot MXU combine, "fused" = the Pallas
+            megakernel below, else hasht): the sort-free fold with its
+            exactness ladder, rebuilt per fold (ops/hash_table.fold_into
+            — see there for why the incremental variant measured worse
+            and is not wired).  Either way the running distinct-key
+            count is measured BEFORE the capacity slice so a truncation
+            in any fold is observable.
+
+            Fused kernel path: the block pre-aggregates IN VMEM (the
+            [lines, emits, key_width] token tensor never touches HBM)
+            and the settlement folds (acc + kernel table + residual)
+            through the SAME aggregate_exact as "hasht" — the final
+            table is a pure function of the distinct-key set and the
+            per-key totals, so it is bit-identical to the hasht fold
+            (ops/pallas/fused_fold.py module docstring; pinned by
+            tests/test_fused_fold.py).  A residual-buffer overflow in
+            the kernel re-folds the block through the stock path via
+            lax.cond — exact either way, and the overflow counter is
+            the kernel's under both branches (identical tokenize
+            formulation).
+            """
+            if self._fused_kernel_on:
+                from locust_tpu.ops.pallas.fused_fold import (
+                    fused_block_preagg,
+                )
+
+                interpret = jax.default_backend() != "tpu"
+                ktab, kresid, overflow, bad = fused_block_preagg(
+                    lines, cfg, interpret=interpret
+                )
+
+                def fused_path(acc_in):
+                    return fold_into(
+                        acc_in, KVBatch.concat(ktab, kresid), tsize,
+                        combine, mode,
+                    )
+
+                def stock_path(acc_in):
+                    kv, _ = map_fn(lines, cfg)
+                    return fold_into(acc_in, kv, tsize, combine, mode)
+
+                merged, distinct = jax.lax.cond(
+                    bad, stock_path, fused_path, acc
+                )
+                return merged, overflow, distinct
+            return stock_fold(acc, lines)
+
+        def stock_fold(acc: KVBatch, lines: jax.Array):
+            """The kernel-free fold — fold_block's non-kernel tail, and
+            the breaker-failover executable: the CPU fallback must never
+            trace the Mosaic kernel (at failover trace time
+            jax.default_backend() is still the dead primary, so the
+            in-fold interpret switch cannot see the migration;
+            run_checkpointed dispatches THIS on the fallback device).
+            Bit-identical outputs to the kernel path by the settlement
+            argument, so mid-job migration changes nothing downstream.
             """
             kv, overflow = map_fn(lines, cfg)
             merged, distinct = fold_into(acc, kv, tsize, combine, mode)
@@ -323,6 +394,15 @@ class MapReduceEngine:
         # first (_CheckpointPump.mark).
         donate = (0,) if cfg.donate_fold else ()
         self._fold_block = jax.jit(fold_block, donate_argnums=donate)
+        # Breaker-failover fold (run_checkpointed's on-CPU dispatch):
+        # identical to _fold_block unless the fused kernel is on — then
+        # it is the kernel-free stock fold (see stock_fold above).
+        # Traced lazily, so non-failover runs never pay its compile.
+        self._fold_block_fallback = (
+            jax.jit(stock_fold, donate_argnums=donate)
+            if self._fused_kernel_on
+            else self._fold_block
+        )
         self._scan_blocks_into = jax.jit(scan_blocks_into, donate_argnums=donate)
         # The export/compile-check surface (__graft_entry__.entry, the
         # TPU StableHLO lowering gates) keeps the one-argument signature.
@@ -797,8 +877,11 @@ class MapReduceEngine:
                     try:
                         if on_cpu:
                             blk = jax.device_put(blk, cpu_dev)
-                            acc, blk_overflow, distinct = self._fold_block(
-                                acc, blk
+                            # _fold_block_fallback, not _fold_block: the
+                            # fused kernel must not re-trace for the
+                            # fallback device (stock_fold docstring).
+                            acc, blk_overflow, distinct = (
+                                self._fold_block_fallback(acc, blk)
                             )
                         elif breaker is not None:
                             acc, blk_overflow, distinct = (

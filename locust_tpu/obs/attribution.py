@@ -51,6 +51,7 @@ def family_join(summary: dict, sort_mode: str) -> dict:
     sort_ms = summary.get("sort_ms")
     scatter_ms = summary.get("scatter_ms")
     dot_ms = summary.get("dot_ms")
+    kernel_ms = summary.get("kernel_ms")
     family = "sort"
     process_ms = sort_ms
     if sort_mode in HASHT_FAMILY:
@@ -59,6 +60,14 @@ def family_join(summary: dict, sort_mode: str) -> dict:
         if sort_mode == "hasht-mxu":
             process_ms += dot_ms or 0.0
             family = "scatter+sort+dot"
+        elif sort_mode == "fused":
+            # The megakernel's device time is ONE custom call
+            # (profiling.FUSED_KERNEL_OP_FRAGMENTS) the scatter/sort
+            # families never see; the mode's traffic model includes the
+            # kernel's bytes (roofline est_kernel_bytes), so its time
+            # must pair in too — the hasht-mxu dot-family rule again.
+            process_ms += kernel_ms or 0.0
+            family = "scatter+sort+kernel"
     return {
         "process_family": family,
         "process_device_ms": (
@@ -67,6 +76,7 @@ def family_join(summary: dict, sort_mode: str) -> dict:
         "sort_device_ms": sort_ms,
         "scatter_device_ms": scatter_ms,
         "dot_device_ms": dot_ms,
+        "kernel_device_ms": kernel_ms,
         "device_total_ms": summary.get("device_total_ms"),
         "device_plane": summary.get("device_plane"),
     }

@@ -82,6 +82,11 @@ class SpanTimer:
 # kernel lowers to Mosaic custom-calls ("tpu_custom_call" is the Mosaic
 # wrapper name).  Fusions are NOT counted (they hold map/reduce
 # elementwise work), so the sort figure is a floor on sort device time.
+# The fused megakernel's custom-call is EXCLUDED (family_ms exclude=
+# below): it has its own family, and a Mosaic-wrapper name carrying the
+# kernel name would otherwise land in both — double-counting the
+# kernel's ms in family_join's scatter+sort+kernel pairing, the exact
+# inflation the DOT family comment warns about.
 SORT_OP_FRAGMENTS = ("sort", "custom-call", "tpu_custom_call", "mosaic")
 
 # The sort-FREE "hasht" fold's Process work is scatters (slot compete /
@@ -98,6 +103,32 @@ SCATTER_OP_FRAGMENTS = ("scatter", "gather")
 # that excludes the dots would inflate utilization (could exceed 100%).
 # NOT "conv": that substring also matches "convert.N" casts.
 DOT_OP_FRAGMENTS = ("dot",)
+
+# "fused" runs the map->aggregate Pallas megakernel, whose device time
+# lands in ONE custom-call op named after the kernel body
+# (ops/pallas/fused_fold._fused_kernel).  Tracked separately for the
+# same reason as the dots: the mode's traffic model includes the
+# kernel's bytes (roofline est_kernel_bytes), so its measured Process
+# time must include the kernel's ms or the utilization pairing
+# inflates.  Disjoint from the sort family by the exclude rule in
+# family_ms (a Mosaic wrapper op carrying the kernel name counts HERE,
+# never twice).
+FUSED_KERNEL_OP_FRAGMENTS = ("fused_kernel",)
+
+
+def family_ms(totals: dict, fragments, exclude=()) -> float:
+    """Sum of op durations whose name carries any of ``fragments`` and
+    none of ``exclude`` — the one family-attribution rule, module-level
+    so its disjointness (sort vs fused-kernel) is directly testable."""
+    return round(
+        sum(
+            ms
+            for n, ms in totals.items()
+            if any(f in n.lower() for f in fragments)
+            and not any(x in n.lower() for x in exclude)
+        ),
+        3,
+    )
 
 
 def parse_xplane(path: str, top_n: int = 12) -> dict:
@@ -139,23 +170,16 @@ def parse_xplane(path: str, top_n: int = 12) -> dict:
                 totals[name] = totals.get(name, 0.0) + e.duration_ps / 1e9
         if totals:
             top = sorted(totals.items(), key=lambda kv: -kv[1])[:top_n]
-
-            def family_ms(fragments):
-                return round(
-                    sum(
-                        ms
-                        for n, ms in totals.items()
-                        if any(f in n.lower() for f in fragments)
-                    ),
-                    3,
-                )
-
             planes[plane.name] = {
                 "total_ms": round(sum(totals.values()), 3),
                 "top_ops": [[n, round(ms, 3)] for n, ms in top],
-                "sort_ms": family_ms(SORT_OP_FRAGMENTS),
-                "scatter_ms": family_ms(SCATTER_OP_FRAGMENTS),
-                "dot_ms": family_ms(DOT_OP_FRAGMENTS),
+                "sort_ms": family_ms(
+                    totals, SORT_OP_FRAGMENTS,
+                    exclude=FUSED_KERNEL_OP_FRAGMENTS,
+                ),
+                "scatter_ms": family_ms(totals, SCATTER_OP_FRAGMENTS),
+                "dot_ms": family_ms(totals, DOT_OP_FRAGMENTS),
+                "kernel_ms": family_ms(totals, FUSED_KERNEL_OP_FRAGMENTS),
             }
 
     device = next(
@@ -168,6 +192,7 @@ def parse_xplane(path: str, top_n: int = 12) -> dict:
         out["sort_ms"] = planes[device]["sort_ms"]
         out["scatter_ms"] = planes[device]["scatter_ms"]
         out["dot_ms"] = planes[device]["dot_ms"]
+        out["kernel_ms"] = planes[device]["kernel_ms"]
     return out
 
 

@@ -67,6 +67,10 @@ _MODE_OPERANDS = {
     # hasht-mxu: claim/verify row sweeps via sort_pass_count; the value
     # combine's traffic moves to the one-hot term (pipeline_sort_traffic).
     "hasht-mxu": (1, None, False),
+    # fused: the settlement fold's hasht sweeps over the PRE-AGGREGATED
+    # rows (kernel table + residual, not the raw emits); the kernel's own
+    # HBM bytes land in the est_kernel_bytes term (pipeline_sort_traffic).
+    "fused": (1, None, False),
     "hash1": (2, 0, True),     # (folded key, idx), then row gather
     "radix": (2, 0, True),     # folded key + rank arrays, then row gather
     "bitonic": (1, None, False),  # folded key + row payload, VMEM tiles
@@ -112,6 +116,15 @@ def sort_pass_count(n_rows: int, mode: str = "hash") -> int:
         from locust_tpu.config import HASHT_PROBES
 
         return HASHT_PROBES
+    if mode == "fused":
+        # The XLA settlement IS a hasht fold (ops/pallas/fused_fold.py:
+        # aggregate_exact over kernel table + residual) — same sweep
+        # count, over far fewer rows (pipeline_sort_traffic shrinks
+        # rows_per_sort for this mode; the kernel's own bytes are the
+        # est_kernel_bytes term).
+        from locust_tpu.config import HASHT_PROBES
+
+        return 2 * HASHT_PROBES
     k = math.ceil(math.log2(n_rows))
     if mode == "bitonic":
         # HBM round-trips of the Pallas tiled network = entries in the
@@ -144,12 +157,64 @@ def pipeline_sort_traffic(
     emits_per_block: int,
     table_size: int,
     n_blocks: int,
+    block_lines: int | None = None,
+    line_width: int | None = None,
 ) -> dict:
     """Estimated HBM bytes the fold's sorts move end-to-end.
 
     One sort per block (engine.fold_block): accumulator + block emits in
     a single ``table_size + emits_per_block``-row sort.
+
+    ``sort_mode="fused"`` (the Pallas megakernel) REQUIRES
+    ``block_lines``/``line_width``: its per-block bytes are the kernel's
+    own HBM touches (one streaming read of the raw line block, the
+    VMEM-resident table's one flush + decode, the bounded residual
+    stream — all sized off the SAME config knobs the kernel runs with)
+    plus the hasht settlement sweeps over ``table_size + kernel slots +
+    residual rows`` — the emit-count term disappears entirely, which is
+    the mode's whole thesis.
     """
+    if sort_mode == "fused":
+        if block_lines is None or line_width is None:
+            raise ValueError(
+                "fused roofline needs block_lines and line_width (the "
+                "kernel's HBM bytes are sized off the line block, not "
+                "the emit count)"
+            )
+        from locust_tpu.config import (
+            FUSED_RESID_PAD,
+            FUSED_RESIDUAL_ROWS,
+            FUSED_TILE_LINES,
+            fused_table_layout,
+        )
+
+        # The PHYSICAL (sublane-padded) plane layout the kernel
+        # allocates — config.fused_table_layout is the one decider, so
+        # the flushed bytes modeled here are the bytes that crossed HBM.
+        t_hi, t_lo = fused_table_layout()
+        n_tiles = -(-block_lines // FUSED_TILE_LINES)
+        key_w = 4 * key_lanes
+        resid_rows = n_tiles * FUSED_RESIDUAL_ROWS
+        kernel_bytes = (
+            block_lines * line_width                      # line block read
+            + 2 * (key_w + 2) * t_hi * t_lo * 4           # table flush+decode
+            + 2 * resid_rows * (key_w + FUSED_RESID_PAD) * 4  # residual
+        )
+        settle_rows = table_size + t_hi * t_lo + resid_rows
+        passes = sort_pass_count(settle_rows, "fused")
+        per_pass, gather = mode_row_bytes("hasht", key_lanes)
+        per_block = kernel_bytes + settle_rows * (
+            2 * per_pass * passes + gather
+        )
+        return {
+            "sort_mode": sort_mode,
+            "rows_per_sort": settle_rows,
+            "sort_passes": passes,
+            "n_blocks": n_blocks,
+            "fused_grid": [t_hi, t_lo],
+            "est_kernel_bytes": int(n_blocks * kernel_bytes),
+            "est_sort_traffic_bytes": int(n_blocks * per_block),
+        }
     per_pass, gather = mode_row_bytes(sort_mode, key_lanes)
     n_rows = table_size + emits_per_block
     passes = sort_pass_count(n_rows, sort_mode)
@@ -196,10 +261,13 @@ def summarize(
     n_blocks: int,
     elapsed_s: float,
     device_kind: str | None,
+    block_lines: int | None = None,
+    line_width: int | None = None,
 ) -> dict:
     """The bench-facing roofline row: traffic model + achieved vs peak."""
     out = pipeline_sort_traffic(
-        sort_mode, key_lanes, emits_per_block, table_size, n_blocks
+        sort_mode, key_lanes, emits_per_block, table_size, n_blocks,
+        block_lines=block_lines, line_width=line_width,
     )
     gb = out["est_sort_traffic_bytes"] / 1e9
     achieved = gb / elapsed_s if elapsed_s > 0 else 0.0

@@ -31,18 +31,25 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", machine_cache_dir())
 
 # Engine sort modes covered by the end-to-end A/B (phase 3).
 # Priority order: a short window should answer the open questions first —
-# the sort-free hasht fold (VERDICT r4 next #2: the highest-expected-value
-# unknown, ~6x modeled traffic cut, zero TPU measurements), then its
-# MXU-combine variant hasht-mxu (VERDICT r5 item 8: the K_mxu_hist
-# primitive at 52.0 ms / 1.6 s compile vs the J scatter's 107.6, armed
-# here as an engine-level row), then the measured winner hashp2 so the
-# window always re-anchors the incumbent — before re-timing the
-# also-rans.  The Pallas bitonic kernel is DEMOTED to last (VERDICT r5
-# item 4): it measured a 1.26x loser with a 100.7 s compile that eats
-# ~15% of a 12-minute window, so it runs only after every productive
-# mode has a row; tests pin the hasht-family-before-bitonic ordering.
-AB_SORT_MODES = ("hasht", "hasht-mxu", "hashp2", "hashp1", "hashp", "hash",
-                 "hash1", "radix", "bitonic")
+# the sort-free hasht fold (VERDICT r4 next #2), then the fused Pallas
+# megakernel "fused" (ROADMAP item 5: the mode that DELETES the token
+# tensor's HBM round-trip — the highest-expected-value unknown since the
+# hasht rows, modeled strictly below hasht-mxu's bytes; zero TPU
+# measurements yet), then the MXU-combine variant hasht-mxu (VERDICT r5
+# item 8), then the measured winner hashp2 so the window always
+# re-anchors the incumbent — before re-timing the also-rans.  The Pallas
+# bitonic kernel is DEMOTED to last (VERDICT r5 item 4: a 1.26x loser
+# with a 100.7 s compile; its tile/fusion ladders are retired from the
+# check battery outright, docs/PERF.md) — the fused megakernel carries
+# the hand-written-kernel thesis now; tests pin the ordering.
+AB_SORT_MODES = ("hasht", "fused", "hasht-mxu", "hashp2", "hashp1", "hashp",
+                 "hash", "hash1", "radix", "bitonic")
+
+# The first-slot subset scripts/tpu_opportunistic.py measures BEFORE any
+# other phase spends window seconds (fused's engine-level verdict must
+# land even in a window that dies minutes in; rows are ordinary
+# engine_sort_mode_ab rows, so phase 3 resumes past them for free).
+FUSED_AB_MODES = ("hasht", "fused", "hasht-mxu")
 
 # Engines memoized by their frozen EngineConfig: several phases measure
 # the SAME winning configuration (block A/B winner -> pallas False side
@@ -170,6 +177,8 @@ def phase_profile(rows_ab, corpus_bytes, sort_mode: str,
         model = roofline.pipeline_sort_traffic(
             sort_mode, eng.cfg.key_lanes, eng.cfg.emits_per_block,
             eng.cfg.resolved_table_size, n_blocks,
+            block_lines=eng.cfg.block_lines,
+            line_width=eng.cfg.line_width,
         )
         row["est_sort_traffic_bytes"] = model["est_sort_traffic_bytes"]
         peak = roofline.PEAK_HBM_GB_S.get(jax.devices()[0].device_kind)
@@ -487,27 +496,41 @@ def _prior_mode_results(corpus_mb: float, caps) -> dict:
     return out
 
 
-def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
+def phase_fused_ab(rows_ab, corpus_bytes, caps=None) -> str:
+    """First-window-slot fused verdict: engine-level fused vs hasht vs
+    hasht-mxu rows BEFORE any other phase (variant compiles, bitonic
+    anything) can eat the window.  Ordinary ``engine_sort_mode_ab`` rows
+    — _prior_mode_results carries them into phase 3, so nothing is
+    measured twice; bench's evidence tuning reads them the moment they
+    land."""
+    return phase_sort_mode_ab(rows_ab, corpus_bytes, caps=caps,
+                              modes=FUSED_AB_MODES)
+
+
+def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None, modes=None) -> str:
     """Engine end-to-end per sort mode at bench shapes.
 
     Returns the winning mode so phase_block_lines sweeps AT that mode —
     bench.py only adopts a (sort_mode, block_lines) pair a window
-    actually measured together.
+    actually measured together.  ``modes`` restricts the sweep (the
+    fused_ab first-slot phase); default is the full AB_SORT_MODES
+    priority ladder.
     """
     import bench
 
     from locust_tpu.engine import MapReduceEngine
     from locust_tpu.utils import artifacts
 
+    modes = AB_SORT_MODES if modes is None else modes
     corpus_mb = round(corpus_bytes / 1e6, 1)
     results = {
         m: r for m, r in _prior_mode_results(corpus_mb, caps).items()
-        if m in AB_SORT_MODES
+        if m in modes
     }
     if results:
         print(f"[opp] sort-mode A/B resuming; already measured this "
               f"session: {sorted(results)}", file=sys.stderr)
-    for mode in AB_SORT_MODES:
+    for mode in modes:
         if mode in results:
             continue
         try:
@@ -532,6 +555,8 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
                 mode, eng.cfg.key_lanes, eng.cfg.emits_per_block,
                 eng.cfg.resolved_table_size, n_blocks, best,
                 jax.devices()[0].device_kind,
+                block_lines=eng.cfg.block_lines,
+                line_width=eng.cfg.line_width,
             )
             results[mode] = {
                 "mb_s": round(corpus_bytes / 1e6 / best, 2),
@@ -561,6 +586,9 @@ def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None) -> str:
              "modes": dict(results),
              "partial": any(m not in results for m in AB_SORT_MODES)},
         )
+    # The restricted (fused_ab) sweep must not hand downstream phases a
+    # winner the FULL ladder never saw losing: its caller only wants the
+    # rows landed early, so the winner is informational there too.
     winner = max(results, key=lambda m: results[m].get("mb_s", -1.0))
     if "mb_s" not in results[winner]:
         # EVERY mode errored (tunnel died mid-phase, or worse): hand the
@@ -1029,17 +1057,21 @@ def _guard(name: str, fn, default=None):
         return default
 
 
-def run_phases() -> None:
+def run_phases(staged=None) -> None:
     """Phases 2.5 -> 4, decision-driving A/Bs FIRST: the engine sort-mode
     A/B (which steers the next driver bench via evidence tuning, and is
-    the bitonic kernel's engine-level verdict) must land before the
-    informational stage-parity tables — a short window that closes
-    mid-sweep should leave the rows that change behavior, not the ones
-    that only describe it.  Each phase is guarded: a phase-local crash
-    skips to the next phase on a known-live tunnel (fallback params are
-    the committed evidence-tuned config) instead of abandoning the
-    window."""
-    staged = _guard("staging", _staged_rows)
+    the fused megakernel's + bitonic's engine-level verdict) must land
+    before the informational stage-parity tables — a short window that
+    closes mid-sweep should leave the rows that change behavior, not the
+    ones that only describe it.  Each phase is guarded: a phase-local
+    crash skips to the next phase on a known-live tunnel (fallback
+    params are the committed evidence-tuned config) instead of
+    abandoning the window.  ``staged`` lets the full-sweep entry point
+    (tpu_opportunistic, which stages early for its first-slot fused_ab
+    phase) hand over its staging instead of re-paying the 32MB host
+    conversion."""
+    if staged is None:
+        staged = _guard("staging", _staged_rows)
     if staged is None:
         # Staging failed on a live tunnel (bad corpus override, loader
         # OOM): the row-dependent phases can't run, but these three take

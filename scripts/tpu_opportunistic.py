@@ -7,6 +7,9 @@ partial window still leaves committed evidence.  Phase order is by
 DECISION VALUE per compile-second (each already-session-answered phase is
 skipped, see _session_row_ok):
 
+  0. fused_ab: engine-level fused-megakernel vs hasht vs hasht-mxu rows
+     (ordinary engine_sort_mode_ab rows, carried into phase 2's resume)
+     — the first slot, before any compile-heavy phase can eat the window
   1. sort-variant bench at the engine's true Process-stage shape —
      only the PRODUCTIVE variants this session hasn't measured yet (the
      Pallas bitonic variant H is demoted to phase 3)
@@ -122,6 +125,24 @@ def main() -> int:
     if not opp_resume.tunnel_gate():
         return 3
 
+    # Phase 0: the fused megakernel's engine-level verdict — fused vs
+    # hasht vs hasht-mxu rows in the FIRST window slot, before the
+    # variant phase's 10-100s-per-letter tunnel compiles and before any
+    # bitonic anything (ROADMAP item 5; ISSUE 13 arming requirement).
+    # The rows are ordinary engine_sort_mode_ab rows, so the shared
+    # phase 3 resumes past whatever landed here instead of re-measuring;
+    # the staging is handed to run_phases below for the same reason.
+    staged = opp_resume._guard("staging", opp_resume._staged_rows)
+    if staged is not None:
+        rows_ab, corpus_bytes, kw, epl = staged
+        opp_resume._guard(
+            "fused_ab",
+            lambda: opp_resume.phase_fused_ab(
+                rows_ab, corpus_bytes,
+                caps={"key_width": kw, "emits_per_line": epl},
+            ),
+        )
+
     # Phase 1: sort variants at the engine shape (table + block emits).
     env = dict(os.environ)
     # Priority order (a short window should answer the open question
@@ -174,12 +195,12 @@ def main() -> int:
     # Phases 2.5 -> 4 are shared with the window-resume entry point
     # (scripts/opp_resume.py) so the two sweeps can never diverge.
     # They run BEFORE the Pallas check battery AND before the demoted
-    # bitonic variant: the engine sort-mode A/B (hasht + hasht-mxu
-    # verdicts — the round's highest-expected-value unknowns, and the
-    # input bench's evidence tuning adopts) must not starve behind 560s
-    # of kernel-ladder compiles whose headline deliverable (a Pallas
-    # hardware ms) is a measured loser (VERDICT r5 item 4).
-    opp_resume.run_phases()
+    # bitonic variant: the engine sort-mode A/B (fused + hasht +
+    # hasht-mxu verdicts — the round's highest-expected-value unknowns,
+    # and the input bench's evidence tuning adopts) must not starve
+    # behind 560s of kernel-ladder compiles whose headline deliverable
+    # (a Pallas hardware ms) is a measured loser (VERDICT r5 item 4).
+    opp_resume.run_phases(staged=staged)
 
     # Demoted bitonic variant phase (H): only after the productive
     # engine-level A/Bs have had the window.  A 100.7 s compile for a

@@ -302,6 +302,7 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/serve/journal.py",  # hooks serve.journal
         "locust_tpu/serve/pool.py",     # hooks serve.place
         "locust_tpu/backend.py",        # hooks backend.dispatch
+        "locust_tpu/ops/pallas/fused_fold.py",  # hot-path kernel: site-free
         "tests/test_faults.py",
         "docs/FAULTS.md",
     ):
@@ -617,6 +618,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/serve/pool.py",     # emits serve.place/affinity_hits
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
         "locust_tpu/plan/compile.py",   # emits plan.compile/plan.run
+        "locust_tpu/ops/pallas/fused_fold.py",  # kernel: must stay name-free
     ):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)

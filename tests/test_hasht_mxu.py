@@ -343,10 +343,12 @@ def test_roofline_models_hasht_mxu_traffic():
 
 
 def test_sweep_orders_hasht_family_before_bitonic():
-    """The acceptance pin: the engine A/B iterates hasht, then hasht-mxu,
+    """The acceptance pin: the engine A/B iterates hasht, then the fused
+    megakernel (ISSUE 13: armed ahead of hasht-mxu), then hasht-mxu,
     before every other mode, with the demoted bitonic LAST; the variant
     phase's priority no longer contains the bitonic variant H at all
-    (it runs as its own phase after the engine A/Bs)."""
+    (it runs as its own phase after the engine A/Bs), and the full sweep
+    lands the fused_ab rows in the FIRST window slot."""
     import importlib.util
     import sys
 
@@ -358,13 +360,21 @@ def test_sweep_orders_hasht_family_before_bitonic():
     spec.loader.exec_module(m)
     modes = list(m.AB_SORT_MODES)
     assert modes[0] == "hasht"
-    assert modes[1] == "hasht-mxu"
+    assert modes[1] == "fused"
+    assert modes[2] == "hasht-mxu"
     assert modes[-1] == "bitonic"
     assert set(modes) == set(SORT_MODES) - {"lex"}
+    assert tuple(m.FUSED_AB_MODES) == ("hasht", "fused", "hasht-mxu")
     src = open(os.path.join(REPO, "scripts", "tpu_opportunistic.py")).read()
     # Phase-1 priority: productive variants only; H appears solely in the
-    # demoted phase after opp_resume.run_phases().
+    # demoted phase after opp_resume.run_phases(...).
     assert 'priority = ("J", "K", "I", "G", "C", "B", "D", "E", "F")' in src
-    assert src.index("opp_resume.run_phases()") < src.index(
+    assert src.index("opp_resume.run_phases(staged=staged)") < src.index(
         '"LOCUST_SORT_VARIANTS"] = "H"'
     )
+    # fused_ab is the sweep's FIRST phase: before the variant phase and
+    # before anything bitonic can compile.
+    assert src.index("phase_fused_ab") < src.index("sort variants")
+    # The retired bitonic ladders stay opt-in in the check battery.
+    checks = open(os.path.join(REPO, "scripts", "tpu_checks.py")).read()
+    assert "LOCUST_TPU_BITONIC_LADDERS" in checks
