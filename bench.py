@@ -921,7 +921,16 @@ def _recovery_stats() -> dict:
     "Durability guarantee"): journal append overhead per admit, and the
     restart-to-first-result MTTR of a crash-recovery replay.
 
-    Two measurements against in-process loopback daemons:
+    Plus the HA tier (docs/SERVING.md "High availability"): WAL-shipping
+    overhead on the admit path (the shipper ENQUEUE — the only
+    synchronous cost async shipping adds — as a share of admit latency,
+    acceptance <= 5%; the raw wall delta of shipping+standby work on
+    this container's cores is reported beside it honestly) and
+    ``takeover_mttr_s`` — a primary/standby pair, jobs acked and
+    shipped, the primary abandoned kill -9-style, the standby promoted:
+    promote -> first replayed result.
+
+    Measurements against in-process loopback daemons:
 
       * **append overhead** — the same job stream admitted twice, once
         with the write-ahead journal and once without; the journal's own
@@ -1027,6 +1036,71 @@ def _recovery_stats() -> dict:
                 all_s = time.perf_counter() - t0
             finally:
                 d2.close()
+            # Shipping-overhead phase (docs/SERVING.md "High
+            # availability"): the SAME big-corpus admit stream against a
+            # journaled primary that is also WAL-shipping to a live
+            # standby — shipping is async off the admit path, so the
+            # acceptance is <= 5% added admit latency over the
+            # journal-only daemon.
+            sb1 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02,
+                journal_dir=os.path.join(tmp, "journal_sb1"),
+                standby_of="127.0.0.1:9"))
+            sb1.serve_in_thread()
+            dp1 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02,
+                journal_dir=os.path.join(tmp, "journal_ship"),
+                ship_to=f"{sb1.addr[0]}:{sb1.addr[1]}",
+                ship_heartbeat_s=0.2))
+            dp1.serve_in_thread()
+            try:
+                ship_admit_s = admit_wall(dp1, big)
+                ship_enqueue_ms = dp1.shipper.stats()["enqueue_ms_mean"]
+            finally:
+                dp1.close()
+                sb1.close()
+            # Takeover phase: small jobs acked on a fresh primary and
+            # WAL-shipped to its standby, the primary abandoned WITHOUT
+            # close (machine death), the standby promoted —
+            # takeover_mttr_s = promote command -> first replayed
+            # result, takeover_all = the last one.
+            sb2 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02,
+                journal_dir=os.path.join(tmp, "journal_sb2"),
+                standby_of="127.0.0.1:9"))
+            sb2.serve_in_thread()
+            dp2 = ServeDaemon(secret=b"bench-rec", cfg=ServeConfig(
+                dispatch_poll_s=0.02,
+                journal_dir=os.path.join(tmp, "journal_takeover"),
+                ship_to=f"{sb2.addr[0]}:{sb2.addr[1]}",
+                ship_heartbeat_s=0.2))
+            dp2.serve_in_thread()
+            try:
+                admit_wall(dp2, small)  # paused: acked, never dispatched
+                tids = list(dp2._jobs)
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    ss = dp2.shipper.stats()
+                    rs = sb2.receiver.stats()
+                    if ss["acked_seq"] >= ss["shipped_seq"] > 0 \
+                            and rs["missing_spills"] == 0:
+                        break
+                    time.sleep(0.02)
+                # The in-process kill -9 (no drain, no compaction).
+                dp2._shutdown.set()
+                dp2.scheduler.stop()
+                dp2._sock.close()
+                t0 = time.perf_counter()
+                cs = ServeClient(sb2.addr, b"bench-rec", timeout=60.0)
+                cs.promote()
+                take_first_s = None
+                for jid in tids:
+                    cs.wait(jid, timeout=600.0, poll_s=0.02)
+                    if take_first_s is None:
+                        take_first_s = time.perf_counter() - t0
+                take_all_s = time.perf_counter() - t0
+            finally:
+                sb2.close()
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
         out = {
@@ -1046,13 +1120,39 @@ def _recovery_stats() -> dict:
             "replayed": len(ids),
             "mttr_first_result_s": round(first_s, 3),
             "mttr_all_results_s": round(all_s, 3),
+            # HA takeover (docs/SERVING.md "High availability").
+            # Shipping is ASYNC: the only cost the admit PATH pays is
+            # the shipper enqueue, accounted by the shipper itself —
+            # that is the <= 5%-of-admit acceptance number.  The wall
+            # delta of the whole admit stream is reported beside it
+            # honestly: on this container's single core (the PR 11
+            # lesson) the standby's concurrent spill transfer + fsync
+            # CPU shows up in wall clock, which measures the machine,
+            # not the admit path.
+            "ship_admit_ms": round(ship_admit_s * 1e3, 3),
+            "ship_enqueue_ms": ship_enqueue_ms,
+            "ship_overhead_pct": round(
+                100.0 * (ship_enqueue_ms or 0.0)
+                / (journal_admit_s * 1e3), 2
+            ) if journal_admit_s > 0 else None,
+            "ship_wall_overhead_pct": round(
+                100.0 * (ship_admit_s - journal_admit_s)
+                / journal_admit_s, 2
+            ) if journal_admit_s > 0 else None,
+            "cores": os.cpu_count(),
+            "takeover_replayed": len(tids),
+            "takeover_mttr_s": round(take_first_s, 3),
+            "takeover_all_results_s": round(take_all_s, 3),
         }
         print(
             f"[bench] recovery: append {out['journal_append_ms']}ms "
             f"({out['append_overhead_pct']}% of {out['admit_ms']}ms "
             f"admit, spill {out['journal_spill_ms']}ms), replay "
             f"{out['replayed']} jobs, first result "
-            f"{out['mttr_first_result_s']}s, all {out['mttr_all_results_s']}s",
+            f"{out['mttr_first_result_s']}s, all {out['mttr_all_results_s']}s; "
+            f"ship overhead {out['ship_overhead_pct']}%, takeover "
+            f"{out['takeover_replayed']} jobs MTTR "
+            f"{out['takeover_mttr_s']}s (all {out['takeover_all_results_s']}s)",
             file=sys.stderr,
         )
         from locust_tpu.utils import artifacts

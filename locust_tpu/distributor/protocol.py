@@ -80,6 +80,55 @@ FETCH_CHUNK_MAX = 32 * 1024 * 1024
 # placement the daemon's local engine absorbs.
 COMMANDS = ("ping", "map", "fetch", "serve_batch", "serve_stats", "shutdown")
 
+# High-availability control plane (serve/replicate.py, docs/SERVING.md
+# "High availability"): the primary serve daemon ships its fsync'd WAL
+# records to a hot standby over this same authenticated frame protocol.
+# ship       = a sequence-numbered batch of journal records (+ heartbeat
+#              when empty); ship_catchup = a full live-journal snapshot
+#              for a standby that connected late or fell behind;
+#              ship_spill = one content-addressed corpus spill, pulled
+#              on demand by sha reference.
+SHIP_COMMANDS = ("ship", "ship_catchup", "ship_spill")
+
+# Fencing epoch: every shipped record and every pool-worker RPC carries
+# the sender's promotion epoch under this key.  Receivers track the
+# highest epoch seen (EpochGuard) and reject lower ones with a
+# structured ``stale_epoch`` — a partitioned old primary can never have
+# its dispatches or ships honored after a standby promotes past it.
+EPOCH_KEY = "_epoch"
+
+
+class EpochGuard:
+    """Monotone fencing-epoch tracker (one per receiving process).
+
+    Thread-safe: serve_batch handlers and ship appliers run on
+    concurrent connection threads, so the high-water mark mutates
+    under a lock.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._highest = 0
+        self._lock = threading.Lock()
+
+    def observe(self, epoch) -> int | None:
+        """Record ``epoch``; returns None when it is current (>= the
+        highest seen, which it then becomes), else the higher epoch
+        already observed — the caller answers a structured
+        ``stale_epoch`` naming it, never silently obeys a fenced-out
+        sender."""
+        e = int(epoch)
+        with self._lock:
+            if e < self._highest:
+                return self._highest
+            self._highest = e
+            return None
+
+    def highest(self) -> int:
+        with self._lock:
+            return self._highest
+
 # Replay window: frames older than this are rejected; nonces are remembered
 # for at least this long (worker side).
 REPLAY_WINDOW_SECS = 120.0

@@ -126,6 +126,11 @@ class Worker:
         self.workdir = os.path.realpath(workdir)
         self.conn_timeout = conn_timeout
         self._replay_guard = protocol.ReplayGuard()
+        # Fencing epoch high-water mark (docs/SERVING.md "High
+        # availability"): serve daemons stamp dispatches with their
+        # promotion epoch; once a newer primary has dispatched here, a
+        # fenced-out zombie's RPCs are rejected structured stale_epoch.
+        self._epoch_guard = protocol.EpochGuard()
         self._map_lock = threading.Lock()
         # Bounded concurrency: without a cap, an unauthenticated peer
         # opening idle connections would spawn unbounded threads (each
@@ -430,6 +435,23 @@ class Worker:
         if self._serve_cache is None:
             return {"status": "error",
                     "error": "serve dispatch not enabled (start with --serve)"}
+        if protocol.EPOCH_KEY in req:
+            try:
+                stale = self._epoch_guard.observe(req[protocol.EPOCH_KEY])
+            except (TypeError, ValueError):
+                return {"status": "error",
+                        "error": f"bad fencing epoch "
+                                 f"{req[protocol.EPOCH_KEY]!r}"}
+            if stale is not None:
+                # The zombie-primary fence: this worker has already
+                # served a newer primary — obeying the old one would be
+                # the split-brain double-answer HA forbids.  The ONE
+                # fencing-reply shape (serve/replicate.py): the reply
+                # carries the high-water epoch so the fenced daemon
+                # adopts the REAL fence instead of guessing.
+                from locust_tpu.serve.replicate import stale_reply
+
+                return stale_reply(stale, None)
         from locust_tpu.config import EngineConfig
         from locust_tpu.serve import batch as batching
         from locust_tpu.serve.jobs import (

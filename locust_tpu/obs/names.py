@@ -37,6 +37,7 @@ NAMES = {
     "serve.dispatch": "span",       # serve: one coalesced batch dispatch
     "serve.place": "span",          # serve: pool placement decision (pool.py)
     "serve.demux": "span",          # serve: per-job result split + store
+    "serve.ship": "span",           # serve: one WAL ship/catch-up RPC (replicate.py)
     "plan.compile": "span",         # plan: DAG lowering onto the engine
     "plan.run": "span",             # plan: one compiled-plan execution
     # --- instant events ----------------------------------------------
@@ -50,6 +51,7 @@ NAMES = {
     "serve.reject": "event",        # serve: admission rejected (reason code)
     "serve.retry": "event",         # serve: failed dispatch requeued w/ backoff
     "serve.replay": "event",        # serve: journal replay summary at startup
+    "serve.takeover": "event",      # serve: role change (promotion / demotion)
     "backend.breaker_open": "event",       # breaker tripped: primary ineligible
     "backend.breaker_half_open": "event",  # cooldown over: one probe allowed
     "backend.breaker_close": "event",      # probe succeeded: primary restored
@@ -69,6 +71,7 @@ NAMES = {
     "serve.result_cache_hits": "counter",  # result cache answered a submit
     "serve.affinity_hits": "counter",      # pool placements on the warm worker
     "serve.journal_ms": "histogram",  # per-append journal write latency
+    "serve.ship_lag": "gauge",      # replication lag in unacked WAL records
     "backend.breaker_trips": "counter",  # closed->open breaker transitions
 }
 

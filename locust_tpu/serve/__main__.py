@@ -17,6 +17,12 @@
     python -m locust_tpu.serve result JOB_ID [--wait]       # fetch by id
     python -m locust_tpu.serve stats                        # daemon stats
     python -m locust_tpu.serve shutdown                     # stop it
+    python -m locust_tpu.serve promote [--port P]           # standby ->
+        # primary takeover (docs/SERVING.md "High availability"); run
+        # the standby with --journal-dir DIR --standby-of H:P and the
+        # primary with --journal-dir DIR --ship-to H:P; client commands
+        # take --daemon H:P,H:P (an HA roster with transparent
+        # not_primary redirect following)
 
 A structured daemon rejection (``ServeError``) prints as
 ``error: [code] message`` and exits 1 — the code is the machine-readable
@@ -35,7 +41,7 @@ import sys
 
 from locust_tpu.utils import faultplan
 
-_CLIENT_CMDS = ("submit", "result", "stats", "shutdown")
+_CLIENT_CMDS = ("submit", "result", "stats", "shutdown", "promote")
 
 
 def _secret(args) -> bytes:
@@ -79,30 +85,37 @@ def _daemon_main(argv) -> int:
     p.add_argument("--shard-min-blocks", type=int, default=64,
                    help="blocks at which a large job fans out across "
                         "the worker pool (with --workers)")
+    p.add_argument("--ship-to", default=None, metavar="H:P",
+                   help="high availability (docs/SERVING.md): ship every "
+                        "fsync'd WAL record to the hot-standby daemon at "
+                        "this address (requires --journal-dir; shipping "
+                        "is async — a dead standby never slows admits)")
+    p.add_argument("--standby-of", default=None, metavar="H:P",
+                   help="start as a HOT STANDBY of the primary at this "
+                        "address (requires --journal-dir): apply shipped "
+                        "WAL records, answer stats/ping, refuse the job "
+                        "plane with not_primary until promoted "
+                        "(`... promote` or --lease expiry)")
+    p.add_argument("--lease", type=float, default=None, metavar="S",
+                   help="standby auto-promotion: take over after S "
+                        "seconds without primary contact (default: "
+                        "manual `promote` only)")
     args = p.parse_args(argv)
     faultplan.install(args.fault_plan)
     from locust_tpu import obs
 
     if args.trace_out:
         obs.enable(process="serve")
-    from locust_tpu.serve.daemon import ServeConfig, ServeDaemon
-
-    daemon = ServeDaemon(
-        args.host, args.port, _secret(args),
-        cfg=ServeConfig(
-            max_queue=args.max_queue,
-            max_batch=args.max_batch,
-            tenant_quota=args.tenant_quota,
-            warm_dir=args.warm_dir,
-            journal_dir=args.journal_dir,
-            workers=tuple(
-                w.strip() for w in (args.workers or "").split(",")
-                if w.strip()
-            ),
-            shard_min_blocks=args.shard_min_blocks,
-        ),
-    )
-    print(f"[serve] listening on {daemon.addr[0]}:{daemon.addr[1]}",
+    try:
+        daemon = _build_daemon(args)
+    except ValueError as e:
+        # Config refusals (e.g. --ship-to without --journal-dir) are an
+        # operator one-liner, not a traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"[serve] listening on {daemon.addr[0]}:{daemon.addr[1]}"
+          + (f" (role {daemon.role})" if (args.standby_of or args.ship_to)
+             else ""),
           file=sys.stderr)
     try:
         daemon.serve_forever()
@@ -121,10 +134,45 @@ def _daemon_main(argv) -> int:
     return 0
 
 
+def _build_daemon(args):
+    from locust_tpu.serve.daemon import ServeConfig, ServeDaemon
+
+    return ServeDaemon(
+        args.host, args.port, _secret(args),
+        cfg=ServeConfig(
+            max_queue=args.max_queue,
+            max_batch=args.max_batch,
+            tenant_quota=args.tenant_quota,
+            warm_dir=args.warm_dir,
+            journal_dir=args.journal_dir,
+            workers=tuple(
+                w.strip() for w in (args.workers or "").split(",")
+                if w.strip()
+            ),
+            shard_min_blocks=args.shard_min_blocks,
+            ship_to=args.ship_to,
+            standby_of=args.standby_of,
+            lease_s=args.lease,
+        ),
+    )
+
+
 def _client(args):
     from locust_tpu.serve.client import ServeClient
 
-    return ServeClient((args.host, args.port), _secret(args))
+    # --daemon H:P[,H:P...] is the HA roster spelling: the client tries
+    # each address, follows not_primary redirects, and sticks to
+    # whoever answers — submit/result/stats survive a takeover without
+    # the operator editing commands (docs/SERVING.md).
+    addr = getattr(args, "daemon", None) or (args.host, args.port)
+    return ServeClient(addr, _secret(args))
+
+
+def _add_daemon_arg(p) -> None:
+    p.add_argument("--daemon", default=None, metavar="H:P[,H:P]",
+                   help="daemon address roster (overrides --host/--port): "
+                        "multiple addresses = HA failover, the client "
+                        "follows not_primary redirects transparently")
 
 
 def _submit_main(argv) -> int:
@@ -133,6 +181,7 @@ def _submit_main(argv) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1347)
     p.add_argument("--secret-env", default="LOCUST_SECRET")
+    _add_daemon_arg(p)
     p.add_argument("--tenant", default="default")
     # Default None, not "wordcount": an explicitly named workload must
     # stay distinguishable so --plan + --workload is a loud conflict
@@ -226,6 +275,7 @@ def _result_main(argv) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1347)
     p.add_argument("--secret-env", default="LOCUST_SECRET")
+    _add_daemon_arg(p)
     p.add_argument("--wait", action="store_true",
                    help="poll until the job finishes instead of "
                         "answering not_done")
@@ -246,11 +296,21 @@ def _stats_main(argv, cmd: str) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1347)
     p.add_argument("--secret-env", default="LOCUST_SECRET")
+    _add_daemon_arg(p)
     args = p.parse_args(argv)
     client = _client(args)
     if cmd == "shutdown":
         client.shutdown()
         print("[serve] daemon shutting down", file=sys.stderr)
+        return 0
+    if cmd == "promote":
+        # Fenced takeover (docs/SERVING.md "High availability"): point
+        # this at the STANDBY — it bumps the epoch, replays the
+        # replicated WAL and starts dispatching; the old primary is
+        # fenced out by the higher epoch wherever it reappears.
+        res = client.promote()
+        print(f"[serve] promoted: role={res['role']} epoch={res['epoch']}",
+              file=sys.stderr)
         return 0
     print(json.dumps(client.stats(), indent=2, default=str))
     return 0

@@ -27,25 +27,104 @@ class ServeError(RuntimeError):
         super().__init__(f"[{code}] {message}")
 
 
+def _parse_addr(spec) -> tuple[str, int]:
+    if isinstance(spec, (tuple, list)) and len(spec) == 2:
+        return (str(spec[0]), int(spec[1]))
+    host, _, port = str(spec).rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"daemon address {spec!r} is not host:port")
+    return (host, int(port))
+
+
+# Bound on redirect-following per RPC: a not_primary chain longer than
+# this is a misconfigured ring, not a failover.
+_MAX_REDIRECTS = 4
+
+
 class ServeClient:
+    """``addr`` may be ONE address — ``(host, port)`` or ``"host:port"``
+    — or a ROSTER (list/tuple of them, or a comma-separated string): the
+    client tries each in order, follows a standby's structured
+    ``not_primary`` redirect to the address it names, and remembers
+    whichever daemon answered so ``submit``/``result``/``stats`` survive
+    a takeover transparently (docs/SERVING.md "High availability")."""
+
     def __init__(
         self,
-        addr: tuple[str, int],
+        addr,
         secret: bytes,
         timeout: float = 60.0,
     ):
-        self.addr = (addr[0], int(addr[1]))
+        if isinstance(addr, str) and "," in addr:
+            addr = [a.strip() for a in addr.split(",") if a.strip()]
+        if isinstance(addr, str):
+            roster = [_parse_addr(addr)]
+        elif isinstance(addr, (list, tuple)) and len(addr) == 2 and (
+            isinstance(addr[1], int)
+            or (isinstance(addr[1], str) and addr[1].isdigit())
+        ):
+            # The legacy single-address tuple, port int OR numeric
+            # string (the pre-roster constructor coerced with int()).
+            roster = [_parse_addr(addr)]
+        elif isinstance(addr, (list, tuple)) and addr:
+            roster = [_parse_addr(a) for a in addr]
+        else:
+            roster = [_parse_addr(addr)]
+        if not roster:
+            raise ValueError("ServeClient needs at least one address")
+        self.roster = roster
+        self.addr = roster[0]  # the preferred (last-known-good) daemon
         self.secret = secret
         self.timeout = timeout
 
     # ------------------------------------------------------------ plumbing
 
-    def rpc(self, req: dict) -> dict:
-        faultplan.check_connect(self.addr[0], self.addr[1])
-        with socket.create_connection(self.addr, timeout=self.timeout) as s:
+    def _rpc_one(self, addr: tuple[str, int], req: dict) -> dict:
+        faultplan.check_connect(addr[0], addr[1])
+        with socket.create_connection(addr, timeout=self.timeout) as s:
             s.settimeout(self.timeout)
             protocol.send_frame(s, req, self.secret)
             return protocol.recv_frame(s, self.secret)
+
+    def rpc(self, req: dict) -> dict:
+        """One request against the roster: try the last-known-good
+        daemon first, fail over to the others on connection errors, and
+        follow ``not_primary`` redirects to the named primary.  The last
+        connection error re-raises when nobody answers (single-address
+        behavior is unchanged); a structured reply — even an error — is
+        an ANSWER and returns to the caller."""
+        order = [self.addr] + [a for a in self.roster if a != self.addr]
+        last_err: Exception | None = None
+        last_resp: dict | None = None
+        redirects = 0
+        i = 0
+        while i < len(order):
+            addr = order[i]
+            i += 1
+            try:
+                resp = self._rpc_one(addr, req)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last_err = e
+                continue
+            if resp.get("code") == "not_primary":
+                last_resp = resp
+                if resp.get("primary") and redirects < _MAX_REDIRECTS:
+                    redirects += 1
+                    try:
+                        target = _parse_addr(resp["primary"])
+                    except ValueError:
+                        continue
+                    if target not in order:
+                        order.insert(i, target)
+                continue
+            self.addr = addr  # sticky: later RPCs start here
+            return resp
+        if last_resp is not None:
+            # Everyone reachable called themselves a standby: hand the
+            # caller the structured not_primary answer, not a socket
+            # error — the reason code is the actionable part.
+            return last_resp
+        raise last_err
 
     def _rpc_ok(self, req: dict) -> dict:
         resp = self.rpc(req)
@@ -192,6 +271,23 @@ class ServeClient:
         if job_id:
             req["job_id"] = job_id
         return int(self._rpc_ok(req).get("invalidated", 0))
+
+    def promote(self) -> dict:
+        """Promote THE FIRST ROSTER ADDRESS to PRIMARY (fenced epoch
+        bump + WAL replay, docs/SERVING.md "High availability").
+        Deliberately neither redirect-following nor failing-over: an
+        epoch bump fences the other pair member, so it must land on
+        exactly the daemon the operator named — point a single-address
+        client at the standby.  A connection error raises; a primary
+        answers a structured refusal."""
+        resp = self._rpc_one(self.roster[0], {"cmd": "promote"})
+        if resp.get("status") != "ok":
+            raise ServeError(
+                str(resp.get("code", "dispatch_failed")),
+                str(resp.get("error", "promote failed")),
+                reply=resp,
+            )
+        return resp
 
     def stats(self) -> dict:
         return self._rpc_ok({"cmd": "stats"})

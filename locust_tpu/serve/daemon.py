@@ -71,6 +71,7 @@ from locust_tpu.serve.jobs import (
 )
 from locust_tpu.serve.jobs import pairs_bytes as jobs_pairs_bytes
 from locust_tpu.plan import PlanError
+from locust_tpu.serve import replicate
 from locust_tpu.serve.journal import JobJournal
 from locust_tpu.serve.pool import PoolDispatchError
 from locust_tpu.serve.scheduler import AdmitReject, FairScheduler
@@ -81,6 +82,18 @@ logger = logging.getLogger("locust_tpu")
 SERVE_COMMANDS = (
     "ping", "submit", "status", "result", "cancel", "invalidate",
     "stats", "shutdown",
+    # High availability (docs/SERVING.md): "promote" flips a standby to
+    # primary (fenced epoch bump + journal replay); the ship commands
+    # are the primary->standby WAL replication stream
+    # (serve/replicate.py; protocol.SHIP_COMMANDS).
+    "promote", "ship", "ship_catchup", "ship_spill",
+)
+
+# Job-plane commands a STANDBY refuses with the structured not_primary
+# code (naming the primary so roster clients redirect transparently).
+# stats/ping/promote/ship* stay answerable — that is what "hot" means.
+_PRIMARY_ONLY_COMMANDS = (
+    "submit", "status", "result", "cancel", "invalidate",
 )
 
 
@@ -142,6 +155,22 @@ class ServeConfig:
     # placeable workers = the whole job folds locally.
     shard_min_blocks: int = 64
     shard_max: int = 4
+    # High availability (docs/SERVING.md "High availability"): with
+    # ship_to set ("host:port" of a hot standby) the primary ships
+    # every fsync'd WAL record there asynchronously (serve/replicate.py)
+    # — a dead standby degrades to a logged warning + lag gauge, never a
+    # slow admit.  With standby_of set ("host:port" of the primary, the
+    # address not_primary rejections name until ship traffic refines
+    # it) the daemon starts as a WARM STANDBY: it applies shipped
+    # records into its own journal, answers stats/ping only, and
+    # refuses the job plane until promoted — by the explicit `promote`
+    # command, or automatically when lease_s passes with no primary
+    # contact (None = manual promotion only).  Both require journal_dir
+    # (the WAL is what ships).
+    ship_to: str | None = None
+    standby_of: str | None = None
+    lease_s: float | None = None
+    ship_heartbeat_s: float = 2.0
 
 
 class ServeDaemon:
@@ -190,6 +219,31 @@ class ServeDaemon:
             if self.cfg.journal_dir
             else None
         )
+        # High availability (docs/SERVING.md): roles, fencing epoch, and
+        # the replication endpoints.  Both sides of the pair need the
+        # WAL — it is the thing that ships.
+        if (self.cfg.ship_to or self.cfg.standby_of) \
+                and self.journal is None:
+            raise ValueError(
+                "--ship-to / --standby-of require --journal-dir: the "
+                "write-ahead journal is what replication ships"
+            )
+        self.role = "standby" if self.cfg.standby_of else "primary"
+        self.epoch = (
+            replicate.load_epoch(self.cfg.journal_dir)
+            if self.journal is not None else 1
+        )
+        self._seen_epoch = self.epoch   # highest epoch observed anywhere
+        self._primary_hint = self.cfg.standby_of  # who not_primary names
+        self._fenced_by: int | None = None  # epoch that demoted us, if any
+        self._promote_lock = threading.Lock()  # serializes role flips
+        self.receiver = (
+            replicate.ShipReceiver(self.journal)
+            if self.journal is not None else None
+        )
+        if self.receiver is not None:
+            self.receiver.touch()  # the lease clock starts now
+        self.shipper = None
         self.pool = None
         self._pool_spill_owned: str | None = None
         if self.cfg.workers:
@@ -210,6 +264,11 @@ class ServeDaemon:
                 spill_dir=spill_dir,
                 max_inflight=self.cfg.pool_inflight,
                 rpc_timeout=self.cfg.pool_rpc_timeout,
+                # Fencing: every serve_batch RPC carries this daemon's
+                # promotion epoch; a worker that has seen a newer
+                # primary answers structured stale_epoch and the zombie
+                # demotes instead of split-braining (docs/SERVING.md).
+                epoch_fn=lambda: self.epoch,
                 # A pool-owned dir has no journal compaction behind it:
                 # cap it so a long-running distinct-corpus stream cannot
                 # fill the disk (evicted spills re-spill on retry).
@@ -260,12 +319,35 @@ class ServeDaemon:
         # Replay BEFORE the dispatcher exists: re-enqueued jobs must be
         # fully staged (record + corpus) before anything can pop them —
         # the same record-before-admit ordering the submit path keeps.
-        if self.journal is not None:
+        # A STANDBY deliberately skips replay: its journal mirrors the
+        # primary's live set via shipping, and promotion is the moment
+        # replay (and dispatch) begins.
+        if self.journal is not None and self.role == "primary":
             self._replay_journal()
+        if self.cfg.ship_to and self.role == "primary":
+            self._start_shipper()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serve-dispatch", daemon=True
         )
         self._dispatcher.start()
+
+    def _start_shipper(self) -> None:
+        """Wire the async WAL shipper to the standby (primary role only;
+        replay has already run, so the first catch-up snapshot carries
+        exactly the live set)."""
+        from locust_tpu.serve.pool import parse_worker_addr
+
+        self.shipper = replicate.ReplicationShipper(
+            parse_worker_addr(self.cfg.ship_to),
+            self.secret,
+            self.journal,
+            epoch_fn=lambda: self.epoch,
+            advertise=f"{self.addr[0]}:{self.addr[1]}",
+            on_fenced=self._demote,
+            heartbeat_s=self.cfg.ship_heartbeat_s,
+        )
+        self.journal.on_append = self.shipper.enqueue
+        self.shipper.start()
 
     # --------------------------------------------------------- accept loop
 
@@ -319,6 +401,17 @@ class ServeDaemon:
             self._closed = True
             gen = self._completed
         self.scheduler.stop()
+        # Local snapshot: a concurrent fenced _demote() nulls the
+        # attribute, and `if self.shipper ...: self.shipper.stop()`
+        # would re-read it after the check.
+        shipper = self.shipper
+        if shipper is not None:
+            # Before the dispatcher join: the shipper only reads the
+            # journal and its own queue, and stopping it first means the
+            # final terminal records below are the last thing it could
+            # have shipped anyway (the standby's replay recomputes
+            # whatever a lost flush-only record would have said).
+            shipper.stop()
         # The join must outlive one TPU cold compile (20-40s per
         # CLAUDE.md): a shorter timeout lets close() flush + close the
         # warm writer while a dispatch is mid-compile, so that batch's
@@ -458,6 +551,14 @@ class ServeDaemon:
         if cmd == "shutdown":
             self._shutdown.set()
             return {"status": "ok", "bye": True}
+        if cmd == "promote":
+            return self._cmd_promote()
+        if cmd in protocol.SHIP_COMMANDS:
+            return self._cmd_ship(cmd, req)
+        if cmd in _PRIMARY_ONLY_COMMANDS:
+            not_primary = self._not_primary_reply()
+            if not_primary is not None:
+                return not_primary
         if cmd == "submit":
             return self._cmd_submit(req)
         if cmd == "status":
@@ -792,7 +893,225 @@ class ServeDaemon:
             "journal": (
                 self.journal.stats() if self.journal is not None else None
             ),
+            # HA operator surface (docs/SERVING.md "High availability"):
+            # role, fencing epoch, shipping lag / standby application
+            # state — readable without touching logs.
+            "replication": self._replication_stats(),
         }
+
+    # ---------------------------------------------------- high availability
+
+    def _not_primary_reply(self) -> dict | None:
+        """The structured standby refusal for job-plane commands, naming
+        the primary so roster clients redirect transparently — or None
+        when this daemon IS the primary."""
+        with self._lock:
+            if self.role == "primary":
+                return None
+            primary = self._primary_hint
+        if self.receiver is not None:
+            # Ship traffic carries the primary's advertised address —
+            # fresher than any static seed after a chain of failovers.
+            primary = self.receiver.primary() or primary
+        reply = structured_error(
+            "not_primary",
+            f"this daemon is a standby; submit to the primary"
+            + (f" at {primary}" if primary else ""),
+        )
+        if primary:
+            reply["primary"] = primary
+        return reply
+
+    def _cmd_promote(self) -> dict:
+        """Operator-driven takeover.  Refused on a daemon that is
+        already primary (the double-promotion guard): promoting twice —
+        or promoting the live primary by mistake — must be a loud no,
+        not a silent epoch bump that fences a healthy peer."""
+        with self._lock:
+            already = self.role == "primary"
+            epoch = self.epoch
+        if already:
+            return structured_error(
+                "bad_spec",
+                f"promote refused: this daemon is already the primary "
+                f"(epoch {epoch})",
+            )
+        self._promote(reason="command")
+        with self._lock:
+            return {"status": "ok", "role": self.role, "epoch": self.epoch}
+
+    def _cmd_ship(self, cmd: str, req: dict) -> dict:
+        """Route one replication frame (docs/SERVING.md): fence first,
+        then apply.  A primary receiving a VALID (>= epoch) ship has
+        been superseded — it demotes and applies, the split-brain
+        resolution arm of the fencing protocol."""
+        if self.receiver is None:
+            return structured_error(
+                "bad_spec",
+                "this daemon has no journal; start it with --journal-dir "
+                "to receive replication",
+            )
+        incoming = int(req.get(protocol.EPOCH_KEY) or 0)
+        with self._lock:
+            epoch = self.epoch
+            role = self.role
+            self._seen_epoch = max(self._seen_epoch, incoming)
+        if incoming < epoch:
+            # The zombie-primary fence: an old epoch's ship is rejected
+            # structured, and the reply names US as the address to
+            # follow — the zombie demotes instead of split-braining.
+            return replicate.stale_reply(
+                epoch, f"{self.addr[0]}:{self.addr[1]}"
+                if role == "primary" else self._primary_hint,
+            )
+        if role == "primary":
+            if incoming > epoch:
+                # A genuinely newer primary: we are the zombie.
+                self._demote(incoming, req.get("from"))
+            else:
+                # EQUAL epochs: two daemons both believe they are
+                # primary (a misconfigured ring, or a partition healing
+                # before any promotion).  Deterministic tie-break — the
+                # lexicographically smaller advertised address keeps
+                # primaryship — so exactly ONE side demotes; without it
+                # a mutual first-ship race demotes both and the pair
+                # deadlocks with no primary at all.
+                mine = f"{self.addr[0]}:{self.addr[1]}"
+                sender = str(req.get("from") or "")
+                if sender and sender < mine:
+                    self._demote(incoming, sender)
+                else:
+                    return replicate.stale_reply(epoch, mine)
+        # Apply under the promotion lock: a promote() that lands while
+        # this frame is in flight bumps the epoch first, so re-checking
+        # here keeps a just-promoted daemon from applying a stale ship
+        # concurrently with its own replay.
+        with self._promote_lock:
+            with self._lock:
+                if incoming < self.epoch:
+                    return replicate.stale_reply(
+                        self.epoch, f"{self.addr[0]}:{self.addr[1]}"
+                        if self.role == "primary" else self._primary_hint,
+                    )
+            if cmd == "ship":
+                return self.receiver.handle_ship(req)
+            if cmd == "ship_catchup":
+                return self.receiver.handle_catchup(req)
+            return self.receiver.handle_spill(req)
+
+    def _promote(self, reason: str) -> None:
+        """Fenced takeover: bump + persist the epoch past everything
+        ever observed, become primary, then replay the replicated
+        journal exactly like PR 9's restart path — unfinished jobs
+        re-enqueue under their ORIGINAL ids and recompute
+        byte-identically.  Serialized against demotion and concurrent
+        promotes; ship frames arriving after the flip carry the old
+        epoch and bounce off the fence."""
+        with self._promote_lock:
+            with self._lock:
+                if self.role == "primary":
+                    return
+                self.epoch = max(self.epoch, self._seen_epoch) + 1
+                self._seen_epoch = self.epoch
+                self.role = "primary"
+                self._fenced_by = None
+                epoch = self.epoch
+            replicate.store_epoch(self.cfg.journal_dir, epoch)
+            obs.event("serve.takeover", role="primary", epoch=epoch,
+                      reason=reason)
+            logger.warning(
+                "serve daemon promoted to PRIMARY (epoch %d, %s); "
+                "replaying the replicated journal", epoch, reason,
+            )
+            self._replay_journal()
+            if self.cfg.ship_to and self.shipper is None:
+                # Symmetric pair: a promoted standby configured with
+                # --ship-to starts replicating BACK, so the demoted old
+                # primary becomes the new hot standby (ring failover).
+                self._start_shipper()
+
+    def _demote(self, higher_epoch: int, primary=None) -> None:
+        """A newer primary exists (our ship or worker RPC was fenced, or
+        a valid higher-epoch ship arrived): stop acting as primary.
+        Queued jobs fail structured ``not_primary`` — the new primary
+        replays them from the replicated WAL under their original ids,
+        so the structured answer is a redirect, not a loss."""
+        with self._promote_lock:
+            with self._lock:
+                if self.role == "standby":
+                    self._seen_epoch = max(
+                        self._seen_epoch, int(higher_epoch)
+                    )
+                    if primary:
+                        self._primary_hint = str(primary)
+                    return
+                self.role = "standby"
+                self._seen_epoch = max(self._seen_epoch, int(higher_epoch))
+                self._fenced_by = int(higher_epoch)
+                if primary:
+                    self._primary_hint = str(primary)
+                elif self.cfg.ship_to:
+                    self._primary_hint = self.cfg.ship_to
+                hint = self._primary_hint
+            if self.receiver is not None:
+                self.receiver.touch()  # fresh lease: don't instantly re-promote
+            obs.event("serve.takeover", role="standby",
+                      epoch=int(higher_epoch), reason="fenced")
+            logger.warning(
+                "serve daemon FENCED by epoch %d (primary %s): demoting "
+                "to standby", higher_epoch, hint or "unknown",
+            )
+            shipper = self.shipper
+            if shipper is not None:
+                self.journal.on_append = None
+                self.shipper = None
+                shipper.stop()
+            stranded = self.scheduler.drain()
+            if stranded:
+                with self._lock:
+                    for job in stranded:
+                        self._corpus_pop(job.job_id)
+                self._fail_batch(stranded, structured_error(
+                    "not_primary",
+                    "this daemon was demoted to standby mid-queue; the "
+                    "new primary replays this job from the replicated "
+                    "journal under the same id"
+                    + (f" (primary {hint})" if hint else ""),
+                ))
+
+    def _maybe_lease_promote(self) -> None:
+        """Standby lease expiry -> automatic takeover.  Runs on the
+        dispatcher's idle tick; the explicit `promote` command is the
+        other trigger."""
+        if self.cfg.lease_s is None or self.receiver is None:
+            return
+        with self._lock:
+            if self.role == "primary":
+                return
+        age = self.receiver.contact_age_s()
+        if age is not None and age >= self.cfg.lease_s:
+            logger.warning(
+                "primary lease expired (%.1fs > %.1fs without contact)",
+                age, self.cfg.lease_s,
+            )
+            self._promote(reason="lease")
+
+    def _replication_stats(self) -> dict:
+        with self._lock:
+            out = {
+                "role": self.role,
+                "epoch": self.epoch,
+                "seen_epoch": self._seen_epoch,
+                "fenced_by": self._fenced_by,
+                "primary_hint": self._primary_hint,
+                "lease_s": self.cfg.lease_s,
+            }
+        shipper = self.shipper  # snapshot: _demote may null it mid-call
+        if shipper is not None:
+            out["ship"] = shipper.stats()
+        if self.receiver is not None:
+            out["standby"] = self.receiver.stats()
+        return out
 
     # ----------------------------------------------------------- dispatch
 
@@ -865,6 +1184,7 @@ class ServeDaemon:
         ])
 
     def _dispatch_once(self) -> None:
+        self._maybe_lease_promote()
         self._sweep_deadlines()
         # Only an occupied queue is worth a queue-wait span: an idle
         # daemon's poll ticks would bury the timeline in no-op spans.
@@ -1164,6 +1484,20 @@ class ServeDaemon:
                     "serve pool dispatch on %s failed: %s: %s",
                     worker.name, type(e).__name__, e,
                 )
+                if getattr(e, "code", None) == "stale_epoch":
+                    # The worker has served a NEWER primary: we are the
+                    # fenced-out zombie.  Demote with the worker's OWN
+                    # high-water epoch when it sent one — the new
+                    # primary replays these jobs from the replicated
+                    # WAL; the retry ladder below still answers them
+                    # structured here.
+                    worker_epoch = getattr(e, "epoch", None)
+                    with self._lock:
+                        fence = max(
+                            self._seen_epoch, self.epoch + 1,
+                            int(worker_epoch or 0),
+                        )
+                    self._demote(fence)
                 self._retry_or_fail(
                     jobs, corpora,
                     f"pool worker {worker.name}: {type(e).__name__}: {e}",
@@ -1532,8 +1866,17 @@ class ServeDaemon:
         orphaned corpus spills).  Liveness comes from the journal's OWN
         records under its lock (journal.compact) — a daemon-side job
         snapshot would race handler-thread admits fsync'd between the
-        snapshot and the rewrite, silently dropping acked work."""
+        snapshot and the rewrite, silently dropping acked work.
+
+        Replication-aware: compaction SHIPS as a snapshot barrier — the
+        standby re-syncs to the compacted live set, so a catch-up that
+        was mid-flight when the GC ran converges instead of stranding on
+        swept spills (every swept spill's job has a terminal record
+        already in the ship stream)."""
         self.journal.compact()
+        shipper = self.shipper  # snapshot: _demote may null it mid-call
+        if shipper is not None:
+            shipper.barrier()
 
     def _replay_journal(self) -> None:
         """Crash recovery: re-enqueue every journaled job still owed an

@@ -45,6 +45,8 @@ ERROR_CODES = (
     "deadline_exceeded", # the job's deadline_s budget expired (any state)
     "poison_job",        # the job killed max_attempts dispatches; quarantined
     "journal_failed",    # WAL append failed; the accept ack would be a lie
+    "not_primary",       # this daemon is a standby; reply names the primary
+    "stale_epoch",       # sender's fencing epoch is behind; a newer primary rules
 )
 
 # Retry-budget guard rails: a submit may not ask for more attempts than

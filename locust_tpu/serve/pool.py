@@ -61,7 +61,16 @@ logger = logging.getLogger("locust_tpu")
 
 class PoolDispatchError(RuntimeError):
     """A worker dispatch failed (connection death, structured worker
-    error, injected fault).  The daemon's retry ladder absorbs it."""
+    error, injected fault).  The daemon's retry ladder absorbs it.
+    ``code`` carries the worker's structured reason when it answered
+    one — ``stale_epoch`` is the fencing rejection a demoted zombie
+    primary must react to, not merely retry (docs/SERVING.md)."""
+
+    def __init__(self, message: str, code: str | None = None,
+                 epoch: int | None = None):
+        self.code = code
+        self.epoch = epoch  # the rejecting side's fencing epoch, if sent
+        super().__init__(message)
 
 
 def parse_worker_addr(spec) -> tuple[str, int]:
@@ -151,6 +160,7 @@ class WorkerPool:
         max_inflight: int = 1,
         rpc_timeout: float = 600.0,
         spill_cap_bytes: int | None = None,
+        epoch_fn=None,
     ):
         if not workers:
             raise ValueError("WorkerPool needs at least one worker address")
@@ -168,6 +178,11 @@ class WorkerPool:
         # fills — the journal-backed dir has compaction GC, this is the
         # ownerless dir's substitute.  None = someone else GCs.
         self.spill_cap_bytes = spill_cap_bytes
+        # Fencing (docs/SERVING.md "High availability"): when set, every
+        # serve_batch RPC is stamped with the daemon's promotion epoch
+        # (protocol.EPOCH_KEY) so a worker that has served a newer
+        # primary rejects a fenced-out zombie's dispatch structured.
+        self.epoch_fn = epoch_fn
         self._spill_gc_lock = threading.Lock()
         self.workers = [
             PoolWorker(i, parse_worker_addr(w)) for i, w in enumerate(workers)
@@ -389,6 +404,8 @@ class WorkerPool:
             "jobs": jobs,
             "spill_dir": self.spill_dir,
         }
+        if self.epoch_fn is not None:
+            req[protocol.EPOCH_KEY] = int(self.epoch_fn())
         try:
             reply = worker.rpc(req, self.secret, self.rpc_timeout)
         except Exception as e:
@@ -399,7 +416,8 @@ class WorkerPool:
             )
         if reply.get("status") != "ok":
             self._dispatch_failed(
-                worker, f"answered: {reply.get('error')}"
+                worker, f"answered: {reply.get('error')}",
+                code=reply.get("code"), epoch=reply.get("epoch"),
             )
         results = reply.get("results")
         if not isinstance(results, list) or len(results) != len(jobs):
@@ -411,14 +429,19 @@ class WorkerPool:
         return reply
 
     def _dispatch_failed(
-        self, worker: PoolWorker, msg: str, cause=None
+        self, worker: PoolWorker, msg: str, cause=None, code=None,
+        epoch=None,
     ):
         """The ONE failure path out of ``dispatch``: quarantine the
         worker, count it, raise for the caller's retry ladder."""
         self.health.fail(worker.idx)
         with self._lock:
             self._dispatch_failures += 1
-        err = PoolDispatchError(f"worker {worker.name} {msg}")
+        err = PoolDispatchError(
+            f"worker {worker.name} {msg}",
+            code=str(code) if code else None,
+            epoch=int(epoch) if epoch is not None else None,
+        )
         if cause is not None:
             raise err from cause
         raise err

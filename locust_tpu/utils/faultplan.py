@@ -102,6 +102,17 @@ SITES = {
     # stays byte-identical, the pool survives; "delay" = a slow
     # placement decision.  ctx: key (affinity key).
     "serve.place": ("error", "delay"),
+    # serve.ship fires inside the replication shipper just before a
+    # ship/catch-up frame leaves for the standby (serve/replicate.py;
+    # docs/SERVING.md "High availability").  Shipping is asynchronous
+    # off the admit path, so EVERY action leaves the primary's answers
+    # byte-identical: "drop" discards the outgoing batch (the standby
+    # sees a sequence gap and converges through a snapshot catch-up),
+    # "corrupt" mangles the serialized records (the standby's checksum
+    # rejects them — a corrupt record is NEVER applied — and the
+    # primary re-syncs), "delay" stalls the shipper (replication lag
+    # grows and is reported; admits stay fast).  ctx: cmd, seq, n.
+    "serve.ship": ("drop", "corrupt", "delay"),
     # serve.journal fires inside the write-ahead job journal's append
     # (serve/journal.py; docs/SERVING.md): "crash" models the daemon
     # dying mid-append — a TORN record lands on disk and the append

@@ -115,15 +115,26 @@ def main(argv=None) -> int:
         [sys.executable, "-c", _PLAN_SMOKE], cwd=REPO, env=env,
         timeout=300,
     ).returncode
+
+    # Machine-death failover smoke (docs/SERVING.md "High
+    # availability"): a REAL primary+standby pair, the primary
+    # SIGKILL'd holding a wordcount AND a journaled plan job, the
+    # standby promoted via the CLI — both replays byte-identical to
+    # the one-shot CLIs — and the zombie primary's restart fenced with
+    # stale_epoch down to a not_primary-answering standby.
+    failover_rc = subprocess.run(
+        [sys.executable, "-c", _FAILOVER_SMOKE], cwd=REPO, env=env,
+        timeout=420,
+    ).returncode
     print(
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
         f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
         f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}; "
-        f"plan smoke rc={plan_rc}",
+        f"plan smoke rc={plan_rc}; failover smoke rc={failover_rc}",
         file=sys.stderr,
     )
     return (rc or proc.returncode or trace_rc or serve_rc
-            or recovery_rc or pool_rc or plan_rc)
+            or recovery_rc or pool_rc or plan_rc or failover_rc)
 
 
 _TRACE_ROUNDTRIP = """
@@ -441,6 +452,147 @@ finally:
         daemon.kill()
 print("[check] plan smoke ok (two-stage tfidf plan byte-identical to "
       "the one-shot CLI, repeat = plan-keyed result-cache hit)",
+      file=sys.stderr)
+"""
+
+
+_FAILOVER_SMOKE = """
+import json, os, signal, subprocess, sys, tempfile, time
+
+td = tempfile.mkdtemp(prefix="locust_failover_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write(b"alpha beta gamma\\nbeta gamma delta\\n" * 8)
+cfg_flags = ["--block-lines", "8", "--line-width", "64",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "failover-smoke"}
+
+# The oracles: the one-shot CLIs for the wordcount job AND the
+# two-stage tf-idf PLAN job.
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", corpus_path,
+     "--backend", "cpu", "--no-timing"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+tfidf_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", "tfidf", corpus_path,
+     "--backend", "cpu", "--lines-per-doc", "2"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert tfidf_shot.returncode == 0, tfidf_shot.stderr[-800:]
+
+def spawn(extra, env=env):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.serve", "--port", "0"] + extra,
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    line = proc.stderr.readline()
+    assert "listening on" in line, line
+    addr = line.split("listening on ", 1)[1].split(" ")[0].strip()
+    host, _, port = addr.partition(":")
+    return proc, (host, int(port))
+
+from locust_tpu.plan import tfidf_plan
+from locust_tpu.serve.client import ServeClient
+
+SECRET = b"failover-smoke"
+sdir, pdir = os.path.join(td, "standby-j"), os.path.join(td, "primary-j")
+standby, saddr = spawn(["--journal-dir", sdir,
+                        "--standby-of", "127.0.0.1:9"])
+primary, paddr = spawn(["--journal-dir", pdir,
+                        "--ship-to", f"{saddr[0]}:{saddr[1]}"])
+zombie = None
+try:
+    pc = ServeClient(paddr, SECRET, timeout=30.0)
+    sc = ServeClient(saddr, SECRET, timeout=30.0)
+    cfgov = {"block_lines": 8, "line_width": 64, "key_width": 16,
+             "emits_per_line": 8}
+    corpus = open(corpus_path, "rb").read()
+    job_id = pc.submit(corpus=corpus, config=cfgov,
+                       no_cache=True)["job_id"]
+    plan_id = pc.submit(corpus=corpus, config=cfgov,
+                        plan=tfidf_plan(2).to_doc(),
+                        no_cache=True)["job_id"]
+    # Both acks are durable on the primary the instant they return;
+    # wait for the async WAL ship to land them on the standby (the
+    # operator's replication-lag check), then kill the machine.
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        rep = sc.stats()["replication"]["standby"]
+        if rep["applied_seq"] >= 2 and rep["missing_spills"] == 0:
+            break
+        time.sleep(0.1)
+    assert rep["applied_seq"] >= 2 and rep["missing_spills"] == 0, rep
+    primary.send_signal(signal.SIGKILL)
+    primary.wait(timeout=10)
+
+    # Takeover via the CLI surface.
+    promote = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "promote",
+         "--port", str(saddr[1])],
+        env=env, capture_output=True, timeout=60,
+    )
+    assert promote.returncode == 0, promote.stderr[-400:]
+
+    res = sc.wait(job_id, timeout=240.0)
+    got = b"".join(
+        k + b"\\t" + str(v).encode() + b"\\n"
+        for k, v in sorted(res["pairs"])
+    )
+    assert got == one_shot.stdout, (
+        "failover wordcount != one-shot CLI\\n%r\\n%r"
+        % (got[:200], one_shot.stdout[:200])
+    )
+    pres = sc.wait(plan_id, timeout=240.0)
+    assert pres.get("plan") is True, pres.get("plan")
+    assert pres["pairs"][0][0] == tfidf_shot.stdout, (
+        "failover plan result != one-shot tfidf CLI\\n%r\\n%r"
+        % (pres["pairs"][0][0][:200], tfidf_shot.stdout[:200])
+    )
+
+    # The zombie: the old primary's machine comes back on its journal,
+    # still shipping at the standby — its first ship is rejected with
+    # the structured stale_epoch and it demotes itself.
+    zombie, zaddr = spawn(["--journal-dir", pdir,
+                           "--ship-to", f"{saddr[0]}:{saddr[1]}"])
+    zc = ServeClient(zaddr, SECRET, timeout=30.0)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        zrep = zc.stats()["replication"]
+        if zrep["role"] == "standby":
+            break
+        time.sleep(0.1)
+    assert zrep["role"] == "standby", zrep
+    assert zrep["fenced_by"] is not None, zrep
+    raw = zc._rpc_one(zaddr, {"cmd": "submit", "corpus_b64": "YQo="})
+    assert raw.get("code") == "not_primary", raw
+    assert raw.get("primary") == f"{saddr[0]}:{saddr[1]}", raw
+
+    # Roster transparency: a client still pointed at the OLD primary's
+    # address reaches the new one through the redirect.
+    rc = ServeClient([f"{zaddr[0]}:{zaddr[1]}"], SECRET, timeout=30.0)
+    assert rc.stats()["replication"]["role"] == "standby"  # direct hit
+    ack = rc.submit(corpus=corpus, config=cfgov)           # redirected
+    rres = rc.wait(ack["job_id"], timeout=240.0)
+    rgot = b"".join(
+        k + b"\\t" + str(v).encode() + b"\\n"
+        for k, v in sorted(rres["pairs"])
+    )
+    assert rgot == one_shot.stdout
+
+    sc.shutdown()
+    standby.wait(timeout=30)
+    zc.shutdown()
+    zombie.wait(timeout=30)
+finally:
+    for p in (standby, primary, zombie):
+        if p is not None and p.poll() is None:
+            p.kill()
+print("[check] failover smoke ok (primary SIGKILL'd mid-job -> standby "
+      "promoted, wordcount AND plan replays byte-identical to the "
+      "one-shot CLI; zombie restart fenced stale_epoch -> not_primary)",
       file=sys.stderr)
 """
 

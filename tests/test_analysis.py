@@ -301,6 +301,7 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/serve/daemon.py",  # hooks serve.admit + serve.dispatch
         "locust_tpu/serve/journal.py",  # hooks serve.journal
         "locust_tpu/serve/pool.py",     # hooks serve.place
+        "locust_tpu/serve/replicate.py",  # hooks serve.ship
         "locust_tpu/backend.py",        # hooks backend.dispatch
         "locust_tpu/ops/pallas/fused_fold.py",  # hot-path kernel: site-free
         "tests/test_faults.py",
@@ -616,6 +617,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/serve/daemon.py",  # emits the serve.* spans/metrics
         "locust_tpu/serve/journal.py",  # emits serve.journal_ms
         "locust_tpu/serve/pool.py",     # emits serve.place/affinity_hits
+        "locust_tpu/serve/replicate.py",  # emits serve.ship/ship_lag
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
         "locust_tpu/plan/compile.py",   # emits plan.compile/plan.run
         "locust_tpu/ops/pallas/fused_fold.py",  # kernel: must stay name-free
@@ -955,6 +957,7 @@ def test_r011_mutating_real_error_codes_fails_the_gate(tmp_path):
         "locust_tpu/serve/cache.py",
         "locust_tpu/serve/batch.py",
         "locust_tpu/serve/client.py",
+        "locust_tpu/serve/replicate.py",  # emits stale_epoch
         "tests/test_serve.py",
         "tests/test_faults.py",
         "docs/SERVING.md",

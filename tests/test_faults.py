@@ -1489,3 +1489,322 @@ def test_chaos_serve_journal_plan_job_replays_byte_identical(tmp_path):
         assert res["pairs"][0][0] == oracle
     finally:
         d2.close()
+
+
+# --------------------------------------- HA replication tier (ISSUE 14)
+#
+# serve.ship faults hit the primary->standby WAL shipping stream
+# (serve/replicate.py; docs/SERVING.md "High availability").  Contract:
+# shipping is ASYNC off the admit path, so every injected fault leaves
+# the primary's answers byte-identical — the standby either converges
+# (drop -> gap -> snapshot catch-up; corrupt -> checksum reject ->
+# resync, the damaged records are NEVER applied) or honestly reports
+# lag (delay).  Fencing: an old epoch's ship attempts and worker RPCs
+# are rejected with the structured stale_epoch code, and a promote on a
+# daemon that is already primary is refused — no double-answering
+# split brain, ever.
+
+
+def _ha_chaos_pair(tmp_path, standby_kw=None, primary_kw=None):
+    from locust_tpu.serve import ServeConfig, ServeDaemon
+
+    standby = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "standby-journal"),
+        standby_of="127.0.0.1:9", dispatch_poll_s=0.02,
+        **(standby_kw or {}),
+    ))
+    standby.serve_in_thread()
+    primary = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "primary-journal"),
+        ship_to=f"{standby.addr[0]}:{standby.addr[1]}",
+        dispatch_poll_s=0.02, ship_heartbeat_s=0.2, retry_base_s=0.02,
+        **(primary_kw or {}),
+    ))
+    primary.serve_in_thread()
+    return primary, standby
+
+
+def _ship_converged(primary, standby, min_seq, timeout=20.0):
+    """Replication caught up: every enqueued record acked, and the
+    standby's sequence high-water mark reached ``min_seq`` (a catch-up
+    of an already-terminal job legitimately applies zero records, so
+    the mark — not a record count — is the convergence signal)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ps = primary.shipper.stats()
+        ss = standby.receiver.stats()
+        if ps["acked_seq"] >= ps["shipped_seq"] and \
+                ss["applied_seq"] >= min_seq and \
+                ss["missing_spills"] == 0:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_chaos_serve_ship_drop_gap_converges_via_catchup(tmp_path):
+    """serve.ship drop: a ship batch vanishes in flight.  The primary's
+    answer is untouched (async shipping), the standby detects the
+    sequence gap, and the snapshot catch-up converges — dropped
+    replication costs a resync, never divergence."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        p = plan([{"site": "serve.ship", "action": "drop",
+                   "match": {"cmd": "ship"}, "times": 1}])
+        with faultplan.active_plan(p):
+            client = ServeClient(primary.addr, SECRET, timeout=30.0)
+            ack = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                                no_cache=True)
+            res = client.wait(ack["job_id"], timeout=60.0)
+            assert dict(res["pairs"]) == _serve_oracle()  # primary exact
+            assert _ship_converged(primary, standby, 1)
+        assert p.rules[0].fired == 1
+        assert standby.receiver.stats()["resyncs_answered"] >= 1
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_chaos_serve_ship_corrupt_never_applied_then_converges(tmp_path):
+    """serve.ship corrupt: the shipped records rot between the journal
+    and the frame (inside the HMAC boundary).  The standby's checksum
+    rejects the batch — a corrupt record is NEVER applied — and the
+    primary re-syncs through a snapshot; the standby's replayable state
+    ends exactly equal to the primary's live set."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        primary.scheduler.pause()  # keep the job LIVE on both sides
+        p = plan([{"site": "serve.ship", "action": "corrupt",
+                   "match": {"cmd": "ship"}, "times": 1}])
+        with faultplan.active_plan(p):
+            client = ServeClient(primary.addr, SECRET, timeout=30.0)
+            jid = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                                no_cache=True)["job_id"]
+            assert _ship_converged(primary, standby, 1)
+        assert p.rules[0].fired == 1
+        assert standby.receiver.stats()["resyncs_answered"] >= 1
+        # Converged state is the primary's: same live job, same spill.
+        live = standby.journal.live_records()
+        assert [r["job_id"] for r in live] == [jid]
+        assert standby.journal.spill_exists(live[0]["corpus_sha"])
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_chaos_serve_ship_delay_lag_reported_admits_unaffected(tmp_path):
+    """serve.ship delay: a slow standby link.  Admits must not slow
+    down (shipping is off the admit path by construction) and the lag
+    is REPORTED while the delay holds — the operator's signal is the
+    stats lag, not a mystery stall."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        primary.scheduler.pause()
+        p = plan([{"site": "serve.ship", "action": "delay",
+                   "delay_s": 1.5, "match": {"cmd": "ship"},
+                   "times": 1}])
+        with faultplan.active_plan(p):
+            client = ServeClient(primary.addr, SECRET, timeout=30.0)
+            t0 = time.monotonic()
+            client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                          no_cache=True)
+            admit_s = time.monotonic() - t0
+            assert admit_s < 1.0, admit_s  # the 1.5s delay never billed
+            assert _ship_converged(primary, standby, 1)
+        assert p.rules[0].fired == 1
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_chaos_zombie_primary_fenced_structured_and_demotes(tmp_path):
+    """Zombie-primary fencing: after a takeover, the old primary's ship
+    attempts are rejected with the structured stale_epoch code and it
+    DEMOTES itself — its job plane then answers not_primary naming the
+    new primary, never a second answer for the same jobs."""
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    promoted = False
+    try:
+        primary.scheduler.pause()
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        jid = pc.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                        no_cache=True)["job_id"]
+        assert _ship_converged(primary, standby, 1)
+        serve_abandon(primary)
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        sc.promote()
+        promoted = True
+        assert dict(sc.wait(jid, timeout=60.0)["pairs"]) == _serve_oracle()
+        # The zombie restarts on its old journal, still shipping at the
+        # promoted standby: its first ship is fenced ("stale_epoch")
+        # and it must demote instead of split-braining.
+        zombie = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+            journal_dir=str(tmp_path / "primary-journal"),
+            ship_to=f"{standby.addr[0]}:{standby.addr[1]}",
+            dispatch_poll_s=0.02, ship_heartbeat_s=0.2,
+        ))
+        zombie.serve_in_thread()
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and \
+                    zombie.role != "standby":
+                time.sleep(0.05)
+            assert zombie.role == "standby"
+            zrep = ServeClient(zombie.addr, SECRET,
+                               timeout=30.0).stats()["replication"]
+            assert zrep["fenced_by"] == standby.epoch
+            zc = ServeClient(zombie.addr, SECRET, timeout=30.0)
+            raw = zc._rpc_one(zombie.addr,
+                              {"cmd": "submit", "corpus_b64": "YQo="})
+            assert raw.get("code") == "not_primary"
+            assert raw.get("primary") == \
+                f"{standby.addr[0]}:{standby.addr[1]}"
+        finally:
+            zombie.close()
+    finally:
+        if not promoted:
+            primary.close()
+        standby.close()
+
+
+def test_chaos_stale_epoch_ship_rejected_without_demote_confusion(tmp_path):
+    """Direct fence pin: a ship frame carrying an older epoch than the
+    receiver's is answered with the structured stale_epoch code and the
+    receiver's epoch — nothing is applied."""
+    from locust_tpu.distributor import protocol
+    from locust_tpu.serve import ServeClient, ServeConfig, ServeDaemon
+    from locust_tpu.serve.replicate import records_blob
+
+    standby = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "standby-journal"),
+        standby_of="127.0.0.1:9", dispatch_poll_s=0.02,
+    ))
+    standby.serve_in_thread()
+    try:
+        standby._promote(reason="test")  # epoch >= 2 now
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        text, checksum = records_blob(
+            [{"rec": "admit", "job_id": "zombie-job", "v": 1,
+              "corpus_sha": ""}]
+        )
+        raw = sc._rpc_one(standby.addr, {
+            "cmd": "ship", protocol.EPOCH_KEY: 1, "seq_from": 1,
+            "records": text, "sum": checksum, "from": "127.0.0.1:9",
+        })
+        assert raw.get("code") == "stale_epoch"
+        assert raw.get("epoch") == standby.epoch
+        assert all(r["job_id"] != "zombie-job"
+                   for r in standby.journal.live_records())
+    finally:
+        standby.close()
+
+
+def test_chaos_double_promotion_refused(tmp_path):
+    """Promote on a daemon that is already primary — the second promote
+    of a takeover runbook, or a mistyped target — is a loud structured
+    refusal, not a silent epoch bump that fences a healthy peer."""
+    from locust_tpu.serve import ServeClient, ServeError
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        # The live primary refuses promote (a mistyped target) FIRST —
+        # after the standby's takeover below it is legitimately fenced
+        # down to standby, where promote would rightly succeed again.
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        with pytest.raises(ServeError) as e:
+            pc.promote()
+        assert e.value.code == "bad_spec"
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        first = sc.promote()
+        assert first["role"] == "primary"
+        with pytest.raises(ServeError) as e:
+            sc.promote()
+        assert e.value.code == "bad_spec"
+        assert "already the primary" in str(e.value)
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_chaos_compaction_racing_catchup_does_not_strand_standby(tmp_path):
+    """The ISSUE 14 satellite regression: the primary compacts (and GCs
+    a spill) while a catch-up snapshot is IN FLIGHT to the standby.
+    The stale snapshot still lists the job live and its spill is gone —
+    the primary answers the spill pull with `gone`, the terminal record
+    (behind the snapshot in the stream) retires the job, and the
+    compaction's own barrier re-syncs the standby to the compacted live
+    set.  Stranded = lag never drains; the pin is full convergence with
+    zero shipper errors."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        client = ServeClient(primary.addr, SECRET, timeout=30.0)
+        jid = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                            no_cache=True)["job_id"]
+        client.wait(jid, timeout=60.0)
+        assert _ship_converged(primary, standby, 1)
+        sha = primary._jobs[jid].corpus_digest
+        # Model a standby that never got this spill (it fell behind):
+        os.unlink(standby.journal.spill_path(sha))
+        # Hold the NEXT catch-up in flight for 1s: the snapshot is read
+        # before the delay, so the compaction below races it for real.
+        p = plan([{"site": "serve.ship", "action": "delay",
+                   "delay_s": 1.0, "match": {"cmd": "catchup"},
+                   "times": 1}])
+        with faultplan.active_plan(p):
+            catchups_before = standby.receiver.stats()["catchups"]
+            primary.shipper.barrier()          # catch-up takes off ...
+            time.sleep(0.3)                    # ... snapshot read, held
+            primary._compact_journal()         # GC the spill mid-flight
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if standby.receiver.stats()["catchups"] > \
+                        catchups_before and _ship_converged(
+                            primary, standby, 1, timeout=0.1):
+                    break
+                time.sleep(0.05)
+        assert p.rules[0].fired == 1
+        assert _ship_converged(primary, standby, 1)
+        assert primary.shipper.stats()["ship_errors"] == 0
+        # Terminal on the primary -> the standby's replayable set is
+        # empty; nothing waits on a spill that no longer exists.
+        assert standby.journal.live_records() == []
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_chaos_serve_ship_drop_quiescent_stream_still_converges(tmp_path):
+    """The drop with NOTHING behind it: the dropped batch carries the
+    LAST records before the stream goes idle.  The next heartbeat's
+    sequence gap must trigger the resync — without the gap check ahead
+    of the heartbeat early-return, the standby would report a fresh
+    lease forever while permanently missing the acked job."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        primary.scheduler.pause()  # the admit is the LAST record
+        p = plan([{"site": "serve.ship", "action": "drop",
+                   "match": {"cmd": "ship"}, "times": 1}])
+        with faultplan.active_plan(p):
+            client = ServeClient(primary.addr, SECRET, timeout=30.0)
+            jid = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                                no_cache=True)["job_id"]
+            assert _ship_converged(primary, standby, 1)
+        assert p.rules[0].fired == 1
+        # The standby holds the admit + spill: promotion-safe.
+        live = standby.journal.live_records()
+        assert [r["job_id"] for r in live] == [jid]
+        assert standby.journal.spill_exists(live[0]["corpus_sha"])
+    finally:
+        primary.close()
+        standby.close()

@@ -1818,3 +1818,394 @@ def test_daemon_plan_job_replays_from_journal(tmp_path):
         assert res["pairs"][0][0] == _plan_oracle(CORPUS_A)
     finally:
         d2.close()
+
+
+# --------------------------------------------- high availability (ISSUE 14)
+#
+# WAL shipping to a hot standby + fenced promotion (docs/SERVING.md
+# "High availability"): the primary ships every fsync'd journal record
+# asynchronously, the standby refuses the job plane with not_primary
+# until promoted, promotion bumps the fencing epoch and replays exactly
+# like the restart path, and the client roster follows redirects.
+
+
+def _ha_pair(tmp_path, standby_kw=None, primary_kw=None):
+    """One primary shipping to one warm standby, both journaled."""
+    standby = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "standby-journal"),
+        standby_of="127.0.0.1:9",  # seed; ship traffic refines it
+        dispatch_poll_s=0.02, **(standby_kw or {}),
+    ))
+    standby.serve_in_thread()
+    primary = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "primary-journal"),
+        ship_to=f"{standby.addr[0]}:{standby.addr[1]}",
+        dispatch_poll_s=0.02, ship_heartbeat_s=0.3, retry_base_s=0.02,
+        **(primary_kw or {}),
+    ))
+    primary.serve_in_thread()
+    return primary, standby
+
+
+def _wait_replicated(standby, n_records, timeout=20.0):
+    """Bounded wait until the standby has applied >= n_records AND holds
+    every referenced spill — an applied admit is only failover-safe once
+    its corpus bytes landed too (the ack-before-spill window)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = standby.receiver.stats()
+        if st["applied_records"] >= n_records and \
+                st["missing_spills"] == 0:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"standby never replicated {n_records} records + spills: "
+        f"{standby.receiver.stats()}"
+    )
+
+
+def test_ha_requires_journal_dir():
+    with pytest.raises(ValueError, match="journal"):
+        ServeDaemon(secret=SECRET, cfg=ServeConfig(ship_to="127.0.0.1:1"))
+    with pytest.raises(ValueError, match="journal"):
+        ServeDaemon(secret=SECRET,
+                    cfg=ServeConfig(standby_of="127.0.0.1:1"))
+
+
+def test_standby_refuses_job_plane_answers_control_plane(tmp_path):
+    """A standby answers stats/ping (that is what "hot" means) but
+    refuses every job-plane command with the structured not_primary
+    code naming the primary — "not_primary" and the redirect address
+    are what roster clients switch on."""
+    primary, standby = _ha_pair(tmp_path)
+    try:
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        assert sc.ping() is True
+        st = sc.stats()
+        assert st["replication"]["role"] == "standby"
+        for cmd in ("submit", "status", "result", "cancel", "invalidate"):
+            raw = sc._rpc_one(standby.addr, {"cmd": cmd, "job_id": "x",
+                                             "corpus_b64": "YQo="})
+            assert raw.get("code") == "not_primary", (cmd, raw)
+        # The redirect names the REAL primary once ship traffic has
+        # flowed (the static seed is only the cold-start hint).
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            raw = sc._rpc_one(standby.addr,
+                              {"cmd": "submit", "corpus_b64": "YQo="})
+            if raw.get("primary") == \
+                    f"{primary.addr[0]}:{primary.addr[1]}":
+                break
+            time.sleep(0.05)
+        assert raw.get("primary") == f"{primary.addr[0]}:{primary.addr[1]}"
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_ha_promote_replays_under_original_ids_byte_identical(tmp_path):
+    """The machine-death drill, in-process: jobs acked on the primary,
+    WAL shipped, primary killed without any graceful path, standby
+    promoted — the jobs replay under their ORIGINAL ids and answer
+    byte-identically (the deterministic-fold guarantee, now surviving
+    the machine, not just the process)."""
+    primary, standby = _ha_pair(tmp_path)
+    abandoned = False
+    try:
+        primary.scheduler.pause()  # acked, never dispatched: the window
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        ja = pc.submit(corpus=CORPUS_A, config=CFG_OVR,
+                       no_cache=True)["job_id"]
+        jb = pc.submit(corpus=CORPUS_B, config=CFG_OVR,
+                       no_cache=True)["job_id"]
+        _wait_replicated(standby, 2)
+        serve_abandon(primary)
+        abandoned = True
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        res = sc.promote()
+        assert res["role"] == "primary" and res["epoch"] >= 2
+        ra = sc.wait(ja, timeout=120.0)
+        rb = sc.wait(jb, timeout=120.0)
+        assert dict(ra["pairs"]) == oracle(CORPUS_A)
+        assert dict(rb["pairs"]) == oracle(CORPUS_B)
+        # Promotion persisted the bumped epoch: a restart of the
+        # promoted standby must stay ABOVE the fenced-out zombie.
+        from locust_tpu.serve import replicate
+
+        assert replicate.load_epoch(str(tmp_path / "standby-journal")) \
+            == standby.epoch
+    finally:
+        if not abandoned:
+            primary.close()
+        standby.close()
+
+
+def test_ha_lease_expiry_auto_promotes(tmp_path):
+    """The unattended takeover: heartbeats stop (primary machine dead),
+    the standby's lease expires, it promotes itself and answers the
+    acked job exactly."""
+    primary, standby = _ha_pair(tmp_path, standby_kw={"lease_s": 1.0})
+    abandoned = False
+    try:
+        primary.scheduler.pause()
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        jid = pc.submit(corpus=CORPUS_A, config=CFG_OVR,
+                        no_cache=True)["job_id"]
+        _wait_replicated(standby, 1)
+        serve_abandon(primary)
+        abandoned = True
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and standby.role != "primary":
+            time.sleep(0.05)
+        assert standby.role == "primary"
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        assert dict(sc.wait(jid, timeout=120.0)["pairs"]) == \
+            oracle(CORPUS_A)
+    finally:
+        if not abandoned:
+            primary.close()
+        standby.close()
+
+
+def test_ha_shipping_is_async_dead_standby_never_fails_admits(tmp_path):
+    """The no-slow-admit guarantee: with the standby address pointing at
+    a dead port, submits still ack immediately and run exactly — the
+    shipper degrades to lag + warnings, never into the admit path."""
+    daemon = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "journal"),
+        ship_to="127.0.0.1:1",  # nothing listens there
+        dispatch_poll_s=0.02,
+    ))
+    daemon.serve_in_thread()
+    try:
+        client = ServeClient(daemon.addr, SECRET, timeout=30.0)
+        t0 = time.monotonic()
+        ack = client.submit(corpus=CORPUS_A, config=CFG_OVR, no_cache=True)
+        admit_s = time.monotonic() - t0
+        res = client.wait(ack["job_id"], timeout=120.0)
+        assert dict(res["pairs"]) == oracle(CORPUS_A)
+        assert admit_s < 5.0  # nowhere near a connect-retry stall
+        rep = client.stats()["replication"]
+        assert rep["role"] == "primary"
+        assert rep["ship"]["connected"] is False
+        assert rep["ship"]["lag_records"] >= 1
+    finally:
+        daemon.close()
+
+
+def test_ha_late_standby_converges_via_catchup(tmp_path):
+    """A standby that joins AFTER the primary has history: the first
+    contact is a full live-journal snapshot plus on-demand spill pulls,
+    and promotion from that state replays the live job exactly."""
+    # Primary alone first, shipping into the void.
+    standby_dir = str(tmp_path / "standby-journal")
+    primary = None
+    standby = None
+    try:
+        # Reserve the standby's port by building it first but treat the
+        # primary's early life as "standby down": point the primary at
+        # the standby, then only assert AFTER the late catch-up.
+        standby = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+            journal_dir=standby_dir, standby_of="127.0.0.1:9",
+            dispatch_poll_s=0.02,
+        ))
+        primary = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+            journal_dir=str(tmp_path / "primary-journal"),
+            ship_to=f"{standby.addr[0]}:{standby.addr[1]}",
+            dispatch_poll_s=0.02, ship_heartbeat_s=0.3,
+        ))
+        primary.serve_in_thread()
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        done = pc.submit(corpus=CORPUS_B, config=CFG_OVR,
+                         no_cache=True)["job_id"]
+        pc.wait(done, timeout=120.0)  # finished history
+        primary.scheduler.pause()
+        live = pc.submit(corpus=CORPUS_A, config=CFG_OVR,
+                         no_cache=True)["job_id"]
+        # NOW the standby starts serving: the shipper's next pass
+        # catches it up from the snapshot.
+        standby.serve_in_thread()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if standby.receiver.stats()["catchups"] >= 1 and \
+                    standby.journal.spill_exists(
+                        primary._jobs[live].corpus_digest):
+                break
+            time.sleep(0.05)
+        assert standby.receiver.stats()["catchups"] >= 1
+        serve_abandon(primary)
+        sc = ServeClient(standby.addr, SECRET, timeout=30.0)
+        sc.promote()
+        assert dict(sc.wait(live, timeout=120.0)["pairs"]) == \
+            oracle(CORPUS_A)
+    finally:
+        if primary is not None:
+            primary.close()
+        if standby is not None:
+            standby.close()
+
+
+def test_client_roster_fails_over_and_follows_redirect(tmp_path):
+    """ServeClient with a roster: a dead first address is skipped, and a
+    standby's not_primary redirect lands the request on the primary —
+    submit/result/stats survive without the caller renaming anything."""
+    primary, standby = _ha_pair(tmp_path)
+    try:
+        dead = ("127.0.0.1", 1)
+        roster = ServeClient(
+            [dead, standby.addr], SECRET, timeout=30.0,
+        )
+        # Wait until the standby knows the real primary address.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                standby.receiver.primary() is None:
+            time.sleep(0.05)
+        ack = roster.submit(corpus=CORPUS_A, config=CFG_OVR)
+        res = roster.wait(ack["job_id"], timeout=120.0)
+        assert dict(res["pairs"]) == oracle(CORPUS_A)
+        # Sticky: the client now talks to the primary directly.
+        assert roster.addr == (primary.addr[0], primary.addr[1])
+        assert roster.stats()["replication"]["role"] == "primary"
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_client_single_address_behavior_unchanged():
+    """The pre-HA spelling still works: one (host, port), connection
+    errors re-raise to the caller."""
+    c = ServeClient(("127.0.0.1", 1), SECRET, timeout=0.5)
+    assert c.roster == [("127.0.0.1", 1)]
+    with pytest.raises(OSError):
+        c.ping()
+
+
+def test_epoch_guard_monotone():
+    from locust_tpu.distributor import protocol
+
+    g = protocol.EpochGuard()
+    assert g.observe(1) is None
+    assert g.observe(3) is None
+    assert g.observe(2) == 3      # stale: names the fence
+    assert g.observe(3) is None   # equal to the high-water mark: current
+    assert g.highest() == 3
+
+
+def test_ship_receiver_never_applies_corrupt_records(tmp_path):
+    """Unit pin for the corrupt-ship contract: a records blob whose
+    checksum fails is answered resync and nothing touches the journal."""
+    from locust_tpu.serve.journal import JobJournal
+    from locust_tpu.serve.replicate import ShipReceiver, records_blob
+
+    j = JobJournal(str(tmp_path / "j"))
+    r = ShipReceiver(j)
+    text, checksum = records_blob(
+        [{"rec": "admit", "job_id": "a", "v": 1, "corpus_sha": ""}]
+    )
+    mangled = text.replace("admit", "admxt")
+    reply = r.handle_ship({"seq_from": 1, "records": mangled,
+                           "sum": checksum})
+    assert reply["resync"] is True and reply["acked_seq"] == 0
+    assert j.live_records() == []
+    # The intact blob applies.
+    reply = r.handle_ship({"seq_from": 1, "records": text,
+                           "sum": checksum})
+    assert "resync" not in reply and reply["acked_seq"] == 1
+    assert [rec["job_id"] for rec in j.live_records()] == ["a"]
+    # A sequence GAP is a resync, applied out of order never.
+    text2, sum2 = records_blob(
+        [{"rec": "admit", "job_id": "b", "v": 1, "corpus_sha": ""}]
+    )
+    reply = r.handle_ship({"seq_from": 5, "records": text2, "sum": sum2})
+    assert reply["resync"] is True
+    assert [rec["job_id"] for rec in j.live_records()] == ["a"]
+    j.close()
+
+
+def test_stats_replication_and_journal_subdicts(tmp_path):
+    """The HA operator surface: stats carries a replication sub-dict
+    (role/epoch/ship lag or standby application state) and the journal
+    sub-dict reports live records, spill bytes and the last compaction
+    — readable without logs (the ISSUE 14 satellite)."""
+    primary, standby = _ha_pair(tmp_path)
+    try:
+        pc = ServeClient(primary.addr, SECRET, timeout=30.0)
+        jid = pc.submit(corpus=CORPUS_A, config=CFG_OVR,
+                        no_cache=True)["job_id"]
+        pc.wait(jid, timeout=120.0)
+        _wait_replicated(standby, 1)
+        ps = pc.stats()
+        rep = ps["replication"]
+        assert rep["role"] == "primary" and rep["epoch"] >= 1
+        ship = rep["ship"]
+        for key in ("standby", "connected", "shipped_seq", "acked_seq",
+                    "lag_records", "lag_bytes", "last_catchup_t"):
+            assert key in ship, key
+        jstats = ps["journal"]
+        for key in ("live", "spill_bytes", "last_compact_t"):
+            assert key in jstats, key
+        ss = ServeClient(standby.addr, SECRET, timeout=30.0).stats()
+        srep = ss["replication"]
+        assert srep["role"] == "standby"
+        for key in ("applied_seq", "applied_records", "catchups",
+                    "primary", "contact_age_s"):
+            assert key in srep["standby"], key
+    finally:
+        primary.close()
+        standby.close()
+
+
+def test_equal_epoch_dual_primary_tie_break(tmp_path):
+    """Two daemons that BOTH believe they are primary at the same epoch
+    (a misconfigured ring, or a partition healing pre-promotion): the
+    address tie-break demotes exactly ONE of them — a mutual first-ship
+    race must not demote both and leave the pair with no primary."""
+    a = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "a-journal"),
+        ship_to="127.0.0.1:9",  # nothing there; A stays epoch-1 primary
+        dispatch_poll_s=0.02, ship_heartbeat_s=0.2,
+    ))
+    a.serve_in_thread()
+    b = ServeDaemon(secret=SECRET, cfg=ServeConfig(
+        journal_dir=str(tmp_path / "b-journal"),
+        ship_to=f"{a.addr[0]}:{a.addr[1]}",  # B ships AT primary A
+        dispatch_poll_s=0.02, ship_heartbeat_s=0.2,
+    ))
+    b.serve_in_thread()
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            roles = {a.role, b.role}
+            if roles == {"primary", "standby"}:
+                break
+            time.sleep(0.05)
+        assert {a.role, b.role} == {"primary", "standby"}, (a.role, b.role)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_client_legacy_string_port_tuple_still_single_address():
+    """The pre-roster constructor coerced ('host', '1347') with int():
+    the roster heuristic must not reinterpret that tuple as two
+    addresses."""
+    c = ServeClient(("127.0.0.1", "1347"), SECRET)
+    assert c.roster == [("127.0.0.1", 1347)]
+
+
+def test_client_promote_never_fails_over(tmp_path):
+    """promote() targets EXACTLY roster[0]: an epoch bump fences the
+    other pair member, so a silent roster fail-over (dead standby A ->
+    accidentally promoting B) would be the misfire the double-promotion
+    guard exists to prevent.  A dead target raises, never redirects."""
+    primary, standby = _ha_pair(tmp_path)
+    try:
+        dead_first = ServeClient(
+            [("127.0.0.1", 1), standby.addr], SECRET, timeout=0.5,
+        )
+        with pytest.raises(OSError):
+            dead_first.promote()
+        assert standby.role == "standby"  # the live standby untouched
+    finally:
+        primary.close()
+        standby.close()
