@@ -77,8 +77,12 @@ FETCH_CHUNK_MAX = 32 * 1024 * 1024
 # surface (serve/pool.py -> worker.py): a worker started WITHOUT
 # --serve answers them with a structured error, and pre-serve workers
 # fall off the same "unknown command" path — both read as a failed
-# placement the daemon's local engine absorbs.
-COMMANDS = ("ping", "map", "fetch", "serve_batch", "serve_stats", "shutdown")
+# placement the daemon's local engine absorbs.  plan_stage is the
+# distributed-plan stage surface (plan/distribute.py, docs/PLAN.md
+# "Distributed execution"): one map split fold or one shuffle-partition
+# reduce per RPC, epoch-fenced like serve_batch.
+COMMANDS = ("ping", "map", "fetch", "serve_batch", "serve_stats",
+            "plan_stage", "shutdown")
 
 # High-availability control plane (serve/replicate.py, docs/SERVING.md
 # "High availability"): the primary serve daemon ships its fsync'd WAL

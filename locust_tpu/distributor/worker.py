@@ -267,6 +267,8 @@ class Worker:
             return self._serve_batch(req)
         if cmd == "serve_stats":
             return self._serve_stats()
+        if cmd == "plan_stage":
+            return self._plan_stage(req)
         # fetch: stream back an intermediate file this worker produced, one
         # bounded window per request so arbitrarily large intermediates fit
         # the frame limit (the master pipelines ``offset`` windows until
@@ -538,6 +540,243 @@ class Worker:
                     "error": f"serve dispatch failed: "
                              f"{type(e).__name__}: {e}"}
         return {"status": "ok", "warm": bool(hit), "results": out}
+
+    # ------------------------------------------------ plan-stage surface
+
+    def _plan_stage(self, req: dict) -> dict:
+        """One distributed-plan stage on this worker (docs/PLAN.md
+        "Distributed execution"): phase "map" folds one source split and
+        publishes its shuffle partitions atomically into the spill dir;
+        phase "reduce" pulls one partition's inputs from their map
+        workers over the binary data plane and returns the combined
+        table.  Epoch-fenced like serve_batch: a fenced-out zombie
+        primary can never get a stale partition published."""
+        if self._serve_cache is None:
+            return {"status": "error",
+                    "error": "serve dispatch not enabled (start with --serve)"}
+        if protocol.EPOCH_KEY in req:
+            try:
+                stale = self._epoch_guard.observe(req[protocol.EPOCH_KEY])
+            except (TypeError, ValueError):
+                return {"status": "error",
+                        "error": f"bad fencing epoch "
+                                 f"{req[protocol.EPOCH_KEY]!r}"}
+            if stale is not None:
+                from locust_tpu.serve.replicate import stale_reply
+
+                return stale_reply(stale, None)
+        phase = req.get("phase")
+        # Chaos: the stage RPC boundary (docs/FAULTS.md).  "crash" models
+        # the worker SIGKILL'd mid-stage (connection dropped, no reply —
+        # the coordinator recomputes the stage on a survivor); "error" a
+        # structured stage failure; "delay" a straggler the coordinator's
+        # speculative backup races.
+        rule = faultplan.fire(
+            "plan.stage", phase=phase, split=req.get("split"),
+            part=req.get("part"), port=self.addr[1],
+        )
+        if rule is not None:
+            if rule.action == "crash":
+                raise faultplan.FaultCrash("injected crash mid-plan-stage")
+            if rule.action == "error":
+                return {"status": "error",
+                        "error": "[faultplan] injected plan stage failure"}
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+        try:
+            with obs.span(
+                "plan.stage", phase=phase, split=req.get("split"),
+                part=req.get("part"), port=self.addr[1],
+            ):
+                if phase == "map":
+                    return self._plan_map_stage(req)
+                if phase == "reduce":
+                    return self._plan_reduce_stage(req)
+                return {"status": "error",
+                        "error": f"unknown plan stage phase {phase!r}"}
+        except Exception as e:  # noqa: BLE001 - structured, worker survives
+            return {"status": "error",
+                    "error": f"plan stage failed: {type(e).__name__}: {e}"}
+
+    def _plan_map_stage(self, req: dict) -> dict:
+        """Fold one source split and publish its shuffle partitions.
+
+        The split's lines come from the content-addressed corpus spill
+        (sha-verified, like serve_batch); doc ids are GLOBAL
+        (``(line_start + i) // lines_per_doc``) so the per-split fold is
+        exactly a restriction of the solo fold.  Partition files publish
+        atomically under (plan fp, split, partition, attempt) — a
+        recompute or speculative backup can never clobber a live file.
+        """
+        import numpy as np
+
+        from locust_tpu.config import EngineConfig
+        from locust_tpu.plan import distribute
+        from locust_tpu.serve import batch as batching
+        from locust_tpu.serve.jobs import SPEC_CONFIG_KEYS, Job, JobSpec
+
+        overrides = req.get("config") or {}
+        if not isinstance(overrides, dict) or (
+            set(overrides) - set(SPEC_CONFIG_KEYS)
+        ):
+            return {"status": "error",
+                    "error": f"bad config overrides {overrides!r}"}
+        try:
+            cfg = EngineConfig(**overrides)
+            fold = str(req["fold"])
+            sha = str(req["sha"])
+            spill_dir = str(req["spill_dir"])
+            plan_fp = str(req["plan_fp"])
+            split = int(req["split"])
+            attempt = int(req["attempt"])
+            n_parts = int(req["n_parts"])
+            a = int(req["line_start"])
+            b = int(req["line_end"])
+            lines_per_doc = int(req.get("lines_per_doc", 1))
+        except (KeyError, TypeError, ValueError) as e:
+            return {"status": "error", "error": f"bad plan_stage: {e}"}
+        try:
+            lines = self._serve_corpus_lines(sha, spill_dir)
+        except ValueError as e:
+            return {"status": "error", "error": str(e)}
+        sl = lines[a:b]
+        truncated, overflow = False, 0
+        if fold == "wordcount":
+            spec = JobSpec(tenant="pool", workload="wordcount", cfg=cfg)
+            n_blocks, bucket = batching.job_shape(len(sl), cfg)
+            ckey = f"{sha}:{a}:{b}"
+            job = Job(
+                job_id=f"plan-{plan_fp}-s{split}", spec=spec,
+                corpus_digest=ckey, n_lines=len(sl), n_blocks=n_blocks,
+                bucket=bucket,
+            )
+            with self._map_lock:  # one accelerator: folds serialize
+                engine, _hit = self._serve_cache.lookup(spec, 1, bucket)
+                res = batching.dispatch_batch(
+                    engine, [job], {ckey: sl}
+                )[0]
+                self._serve_cache.mark_compiled(spec, 1, bucket)
+                pairs = res.to_host_pairs()
+                truncated = bool(res.truncated)
+                overflow = int(res.overflow_tokens)
+            enc = pairs
+        elif fold in ("tf", "index"):
+            from locust_tpu.apps.tfidf import term_doc_counts
+
+            ids = ((a + np.arange(len(sl))) // lines_per_doc).astype(
+                np.int32
+            )
+            with self._map_lock:
+                # The index fold tolerates per-line emit overflow the
+                # way build_inverted_index does (warn, drop) — the solo
+                # path's exact semantics; tf raises, also solo-exact.
+                tf = term_doc_counts(
+                    sl, ids, cfg, allow_overflow=(fold == "index")
+                )
+            enc = [
+                (distribute.encode_key(fold, k), v)
+                for k, v in tf.items()
+            ]
+        else:
+            return {"status": "error", "error": f"unknown fold {fold!r}"}
+        parts = distribute.publish_split(
+            spill_dir, plan_fp, split, attempt, enc, n_parts
+        )
+        return {
+            "status": "ok",
+            "split": split,
+            "attempt": attempt,
+            "worker": f"{self.addr[0]}:{self.addr[1]}",
+            "parts": parts,
+            "truncated": truncated,
+            "overflow_tokens": overflow,
+        }
+
+    def _plan_reduce_stage(self, req: dict) -> dict:
+        """Combine one shuffle partition from its per-split input files.
+
+        Inputs published by OTHER workers move worker-to-worker over the
+        distributor's binary HMAC'd data plane (master.fetch_file:
+        pipelined windows, sha-verified end to end) — the daemon never
+        relays partition bytes.  ANY lost/damaged input answers a
+        structured error naming ``lost_split`` so the coordinator
+        recomputes exactly that map split from its durable corpus split,
+        not the whole plan."""
+        from locust_tpu.plan import distribute
+
+        try:
+            part = int(req["part"])
+            key_width = int(req["key_width"])
+            inputs = list(req["inputs"])
+        except (KeyError, TypeError, ValueError) as e:
+            return {"status": "error", "error": f"bad plan_stage: {e}"}
+        me = f"{self.addr[0]}:{self.addr[1]}"
+        acc: dict = {}
+        for ref in inputs:
+            try:
+                path = str(ref["path"])
+                sha = str(ref["sha256"])
+                owner = str(ref["worker"])
+                split = int(ref["split"])
+            except (KeyError, TypeError, ValueError):
+                return {"status": "error",
+                        "error": f"bad partition ref {ref!r}"}
+            if int(ref.get("pairs", 1)) == 0:
+                continue  # published empty: nothing to move or merge
+            try:
+                if owner == me:
+                    pairs = distribute.read_partition(path, sha, key_width)
+                else:
+                    pairs = self._pull_partition(
+                        owner, path, sha, key_width, part
+                    )
+            except Exception as e:  # noqa: BLE001 - structured loss report
+                return {
+                    "status": "error",
+                    "lost_split": split,
+                    "error": f"partition input lost (split {split}, "
+                             f"part {part}, {owner}): "
+                             f"{type(e).__name__}: {e}",
+                }
+            distribute.merge_pairs(acc, pairs)
+        return {
+            "status": "ok",
+            "part": part,
+            "worker": me,
+            "pairs": [
+                [base64.b64encode(k).decode(), int(v)]
+                for k, v in sorted(acc.items())
+            ],
+        }
+
+    def _pull_partition(
+        self, owner: str, path: str, sha: str, key_width: int, part: int
+    ) -> list:
+        """Fetch one remote partition over the binary data plane and
+        decode it.  The transfer verifies the file sha end-to-end
+        (fetch_file's expect_sha) and the local decode re-verifies —
+        a mangled wire or disk byte is a loss, never a wrong answer."""
+        from locust_tpu.distributor import master
+        from locust_tpu.plan import distribute
+
+        host, _, port = owner.rpartition(":")
+        local = os.path.join(
+            self.workdir,
+            f"pull_{os.path.basename(path)}.{os.getpid()}."
+            f"{threading.get_ident()}",
+        )
+        with obs.span("plan.shuffle", part=part, src=owner):
+            try:
+                master.fetch_file(
+                    (host, int(port)), path, local, self.secret,
+                    expect_sha=sha, rpc_timeout=120.0,
+                )
+                return distribute.read_partition(local, sha, key_width)
+            finally:
+                try:
+                    os.unlink(local)
+                except OSError:
+                    pass
 
     def _serve_corpus_lines(self, sha: str, spill_dir: str) -> list:
         """One spilled corpus read+verified+split, through the tiny LRU

@@ -40,6 +40,8 @@ NAMES = {
     "serve.ship": "span",           # serve: one WAL ship/catch-up RPC (replicate.py)
     "plan.compile": "span",         # plan: DAG lowering onto the engine
     "plan.run": "span",             # plan: one compiled-plan execution
+    "plan.stage": "span",           # plan: one distributed stage RPC (both sides)
+    "plan.shuffle": "span",         # plan: one cross-worker partition transfer
     # --- instant events ----------------------------------------------
     "fault.injected": "event",      # a faultplan rule fired (site, action)
     "ckpt.mark": "event",           # fold loop marked a snapshot generation
@@ -73,6 +75,9 @@ NAMES = {
     "serve.journal_ms": "histogram",  # per-append journal write latency
     "serve.ship_lag": "gauge",      # replication lag in unacked WAL records
     "backend.breaker_trips": "counter",  # closed->open breaker transitions
+    "plan.partition_bytes": "counter",  # published shuffle-partition bytes
+    "plan.recomputes": "counter",   # plan stages recomputed after a failure
+    "plan.speculated": "counter",   # speculative backup stage attempts
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")

@@ -1,0 +1,336 @@
+"""Distributed plan execution: stage programs + shuffle partitions.
+
+The serve tier's production skeleton left one seam open (ROADMAP item
+2): pool dispatch shards *wordcount* batches across workers while *plan*
+jobs — the general analytics surface — ran solo on the daemon's local
+engine, so a plan got none of the pool's retry/quarantine machinery and
+none of the scale-out.  This module is the Dean & Ghemawat answer
+applied to the plan layer (docs/PLAN.md "Distributed execution"):
+
+  * ``plan_shape()`` recognizes the map->shuffle->reduce[->score]->sink
+    spine the engine's folds cover (the same closed ``_FOLDS`` table
+    ``plan/compile.py`` lowers) and returns its distributable shape —
+    anything else stays on the solo path, byte-identical by refusal;
+  * **stage programs**: source splits ride the content-addressed corpus
+    spill, each map split folds on a worker's warm executables, and the
+    shuffle edge moves keyed partitions worker-to-worker over the
+    distributor's binary HMAC'd data plane as packed LKVB files
+    (io/serde.py) instead of folding through one merge on the daemon;
+  * **deterministic re-execution**: a stage attempt's outputs publish
+    ATOMICALLY (tmp + rename into the spill dir, content-addressed by
+    sha256 and keyed by (plan fp, split, partition, attempt)), so a
+    dead worker's lost shuffle partitions recompute from their durable
+    upstream inputs — never a wrong answer, never a full-plan restart;
+  * ``finalize()`` folds the reduced partitions back into the EXACT
+    bytes the solo path renders (``compile.iter_rendered`` is the one
+    spelling of every sink format) — byte-identity is the contract
+    throughout, pinned by tests and the check.py smoke.
+
+Chaos: the ``plan.partition`` site fires between the map and reduce
+waves on every published partition file ("drop" unlinks it — the reduce
+worker's sha/parse check fails structured and the coordinator recomputes
+the split; "corrupt" flips bytes — same recovery, the checksum is the
+tripwire).  ``plan.stage`` (hooked in distributor/worker.py) models the
+stage RPC itself dying.  Telemetry: ``plan.partition_bytes`` counts
+published shuffle bytes (closed obs registry, R009).
+
+jax-free at import like the rest of the plan/serve control plane: the
+fold/render imports are lazy, so validating shapes and reading
+partitions never pays a jax init (CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+from locust_tpu import obs
+from locust_tpu.io import serde
+from locust_tpu.utils import faultplan
+
+from .compile import _FOLDS
+from .nodes import Plan
+
+# Doc-id suffix budget for composite (word, doc) partition keys: the doc
+# id rides a uint32 key lane (apps/tfidf.py), so <= 10 decimal digits
+# plus the NUL separator.
+_DOC_SUFFIX = 11
+
+# The one key/doc separator for composite shuffle keys.  Safe by
+# construction: NUL is a tokenizer delimiter (config.DELIMITERS), so no
+# word ever contains it, and the decimal doc-id suffix keeps read_kvbin's
+# trailing-NUL strip away from the separator.
+PAIR_SEP = b"\x00"
+
+
+@dataclasses.dataclass(frozen=True)
+class StageShape:
+    """The distributable spine of a validated plan: which engine fold
+    the map+reduce pair lowers to, how source lines map to doc ids,
+    whether a tfidf_score stage follows the fold, and the sink op that
+    renders the final table."""
+
+    fold: str           # "wordcount" | "tf" | "index" (compile._FOLDS)
+    lines_per_doc: int  # source param (doc ids are GLOBAL line//k)
+    score: bool         # a map/tfidf_score stage between reduce and sink
+    sink_op: str        # "table" | "tfidf" | "postings"
+
+
+def plan_shape(plan: Plan) -> StageShape | None:
+    """Recognize the map->shuffle->reduce[->score]->sink spine, or None.
+
+    None means the plan stays on the solo engine (pagerank iteration,
+    joins, multi-consumer DAGs, named inputs): the solo path is the
+    correctness floor and refusal here can never change an answer.
+    """
+    by_id = plan.by_id()
+    try:
+        sink = plan.sink()
+    except StopIteration:  # pragma: no cover - validation owns this
+        return None
+    n_expected = 5
+    child = by_id[sink.inputs[0]]
+    score = False
+    if child.kind == "map" and child.op == "tfidf_score":
+        score = True
+        n_expected += 1
+        child = by_id[child.inputs[0]]
+    if child.kind != "reduce":
+        return None
+    reducer = child
+    shuffle = by_id[reducer.inputs[0]]
+    if shuffle.kind != "shuffle":
+        return None
+    mapper = by_id[shuffle.inputs[0]]
+    if mapper.kind != "map":
+        return None
+    src = by_id[mapper.inputs[0]]
+    if src.kind != "source" or src.op != "text":
+        return None
+    if src.param("input", "corpus") != "corpus":
+        return None
+    fold = _FOLDS.get((mapper.op, reducer.op))
+    if fold is None:
+        return None
+    # Exact node count rejects extra consumers hanging off the spine
+    # (a second sink is impossible, but a join/tee re-reading the table
+    # would change what the distributed fold must produce).
+    if len(plan.nodes) != n_expected:
+        return None
+    if (fold, score, sink.op) not in (
+        ("wordcount", False, "table"),
+        ("tf", True, "tfidf"),
+        ("index", False, "postings"),
+    ):
+        return None
+    return StageShape(
+        fold=fold,
+        lines_per_doc=int(src.param("lines_per_doc", 1)),
+        score=score,
+        sink_op=sink.op,
+    )
+
+
+# ------------------------------------------------------- shuffle keying
+
+
+def partition_of(key: bytes, n_parts: int) -> int:
+    """Deterministic shuffle partitioner: sha256-derived so replays and
+    recomputes route every key to the same partition on every host (the
+    stable_shard_id stance — chaos plans and re-executions agree)."""
+    h = hashlib.sha256(key).digest()
+    return int.from_bytes(h[:8], "big") % n_parts
+
+
+def encode_key(fold: str, key) -> bytes:
+    """One wire spelling of a shuffle key: raw word bytes for the
+    wordcount fold, ``word NUL decimal-doc-id`` for the composite
+    (word, doc) folds."""
+    if fold == "wordcount":
+        return key
+    word, doc = key
+    return word + PAIR_SEP + str(int(doc)).encode()
+
+
+def decode_key(fold: str, raw: bytes):
+    if fold == "wordcount":
+        return raw
+    word, _, doc = raw.rpartition(PAIR_SEP)
+    return word, int(doc)
+
+
+def partition_key_width(cfg, fold: str) -> int:
+    """LKVB row width for a fold's encoded keys: engine words are
+    already truncated to ``cfg.key_width``; composite keys append the
+    NUL + doc-id suffix."""
+    if fold == "wordcount":
+        return int(cfg.key_width)
+    return int(cfg.key_width) + _DOC_SUFFIX
+
+
+# -------------------------------------------------- partition publish/read
+
+
+def partition_path(
+    out_dir: str, plan_fp: str, split: int, part: int, attempt: int
+) -> str:
+    """The content-addressed spill name for one stage attempt's output
+    partition — (plan fp, split, partition, attempt) is the identity, so
+    a speculative backup attempt can never clobber the primary's file."""
+    return os.path.join(
+        out_dir, f"plan_{plan_fp}_s{split}_p{part}_a{attempt}.kvb"
+    )
+
+
+def publish_partition(path: str, pairs: list) -> dict:
+    """Atomically publish one partition file (tmp + rename, the corpus
+    spill's own discipline) and return its durable reference: path,
+    sha256 over the serialized bytes, sizes.  ``pairs`` are
+    (encoded key bytes, int count) tuples."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    serde.write_kvbin(pairs, tmp)
+    with open(tmp, "rb") as f:
+        data = f.read()
+    os.replace(tmp, path)
+    obs.metric_inc("plan.partition_bytes", len(data))
+    return {
+        "path": path,
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+        "pairs": len(pairs),
+    }
+
+
+def publish_split(
+    out_dir: str, plan_fp: str, split: int, attempt: int,
+    pairs: list, n_parts: int,
+) -> list[dict]:
+    """Bucket one map split's encoded pairs by partition and publish all
+    ``n_parts`` partition files (empty ones included: a missing file and
+    an empty partition must stay distinguishable — absence means LOSS)."""
+    buckets: list[list] = [[] for _ in range(n_parts)]
+    for key, value in pairs:
+        buckets[partition_of(key, n_parts)].append((key, int(value)))
+    out = []
+    for part, bucket in enumerate(buckets):
+        ref = publish_partition(
+            partition_path(out_dir, plan_fp, split, part, attempt), bucket
+        )
+        ref["part"] = part
+        out.append(ref)
+    return out
+
+
+def read_partition(path: str, expect_sha: str, key_width: int) -> list:
+    """Read + verify one published partition: sha256 gate first (a
+    corrupt or torn file is a structured loss, never a silent wrong
+    answer), then the LKVB decode.  Raises ``ValueError`` on ANY
+    damage — the coordinator's recompute path owns recovery."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise ValueError(f"partition {path} unreadable: {e}")
+    got = hashlib.sha256(data).hexdigest()
+    if got != expect_sha:
+        raise ValueError(
+            f"partition {path} sha mismatch (got {got[:12]}, want "
+            f"{expect_sha[:12]})"
+        )
+    rows, values = serde.read_kvbin(path, key_width)
+    return [
+        (rows[i].tobytes().rstrip(b"\x00"), int(values[i]))
+        for i in range(len(values))
+    ]
+
+
+def merge_pairs(acc: dict, pairs) -> dict:
+    """The reduce stage's combine: sum counts per encoded key (the
+    engine's "sum" fold over disjoint splits of the same corpus)."""
+    for key, value in pairs:
+        acc[key] = acc.get(key, 0) + int(value)
+    return acc
+
+
+def chaos_partition(path: str, split: int, part: int) -> None:
+    """The shuffle-partition chaos window (docs/FAULTS.md): fires
+    between the map and reduce waves on every published partition.
+    "drop" models the spill vanishing mid-plan (GC race, disk loss),
+    "corrupt" a torn/flipped file — both must surface as a recompute,
+    never a wrong answer."""
+    rule = faultplan.fire("plan.partition", path=path, split=split,
+                          part=part)
+    if rule is None:
+        return
+    if rule.action == "drop":
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    elif rule.action == "corrupt":
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            mangled = faultplan.active().mutate(rule, data)
+            with open(path, "wb") as f:
+                f.write(mangled)
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------- finalize
+
+
+def finalize(
+    shape: StageShape, cfg, n_lines: int, partition_pairs: list[list],
+    truncated: bool, overflow: int,
+) -> tuple[bytes, int, bool, int]:
+    """Fold the reduced shuffle partitions into the solo path's exact
+    result: (rendered output bytes, distinct, truncated, overflow).
+
+    Wordcount partitions re-merge through the engine's own
+    sort+segment-reduce (``batching.merge_shard_results``, the sharded
+    wordcount path's proven-identical merge) so pair ORDER matches the
+    solo fold; the composite folds decode into the same host tables the
+    solo evaluator builds and render through ``compile.iter_rendered``
+    — the one spelling of every sink format.  Device work: the caller
+    holds the engine lock.
+    """
+    from locust_tpu.serve import batch as batching
+
+    from .compile import _render
+
+    if shape.fold == "wordcount":
+        shard_results = [
+            {"pairs": pairs, "truncated": False, "overflow_tokens": 0}
+            for pairs in partition_pairs
+        ]
+        shard_results.append({
+            "pairs": [], "truncated": bool(truncated),
+            "overflow_tokens": int(overflow),
+        })
+        pairs, distinct, trunc, ovf = batching.merge_shard_results(
+            shard_results, cfg, "sum"
+        )
+        return _render("table", pairs), distinct, trunc, ovf
+    table: dict = {}
+    for pairs in partition_pairs:
+        for raw, count in pairs:
+            key = decode_key(shape.fold, raw)
+            table[key] = table.get(key, 0) + int(count)
+    if shape.fold == "tf":
+        from locust_tpu.apps.tfidf import scores_from_tf
+
+        # n_docs exactly as the solo evaluator derives it: distinct
+        # GLOBAL doc ids over the input (arange(n) // lines_per_doc).
+        n_docs = -(-int(n_lines) // shape.lines_per_doc) or 1
+        scores = scores_from_tf(table, n_docs)
+        return _render("tfidf", scores), len(scores), False, 0
+    # index: postings = {word: sorted unique doc ids} (the counts only
+    # carried the shuffle; the inverted index keeps membership).
+    postings: dict = {}
+    for word, doc in table:
+        postings.setdefault(word, set()).add(int(doc))
+    postings = {w: sorted(d) for w, d in postings.items()}
+    return _render("postings", postings), len(postings), False, 0

@@ -155,6 +155,12 @@ class ServeConfig:
     # placeable workers = the whole job folds locally.
     shard_min_blocks: int = 64
     shard_max: int = 4
+    # Distributed plan execution (docs/PLAN.md "Distributed execution"):
+    # a map/reduce stage attempt still unfinished this many seconds
+    # after launch gets ONE speculative backup attempt on another held
+    # worker — first finisher wins, the loser's partitions are ignored
+    # (attempt-suffixed filenames keep them from colliding).
+    plan_speculate_s: float = 30.0
     # High availability (docs/SERVING.md "High availability"): with
     # ship_to set ("host:port" of a hot standby) the primary ships
     # every fsync'd WAL record there asynchronously (serve/replicate.py)
@@ -301,6 +307,16 @@ class ServeDaemon:
         # just sockets and never take it.
         self._engine_lock = threading.Lock()
         self._jobs: dict[str, Job] = {}       # insertion order = age
+        # Distributed-plan coordinator state (docs/PLAN.md "Distributed
+        # execution"), both under self._lock: stage/recompute counters
+        # surfaced in the stats "pool" sub-dict, and WAL-replayed stage
+        # progress (job_id -> completed map-split records) so a restart
+        # reuses surviving shuffle partitions instead of remapping.
+        self._plan_counters = {
+            "stages": 0, "recomputes": 0,
+            "speculated": 0, "partitions_reused": 0,
+        }
+        self._plan_progress: dict[str, list] = {}
         self._corpus_bytes: dict[str, bytes] = {}  # job_id -> in-flight bytes
         self._corpus_total = 0  # sum of _corpus_bytes values (admission cap)
         self._result_bytes = 0  # sum of retained job.result_bytes (history cap)
@@ -877,6 +893,7 @@ class ServeDaemon:
             completed = self._completed
             corpus_total = self._corpus_total
             result_bytes = self._result_bytes
+            plan_counters = dict(self._plan_counters)
         return {
             "status": "ok",
             "service": "locust-serve",
@@ -886,7 +903,13 @@ class ServeDaemon:
             "queued_corpus_bytes": corpus_total,
             "history_result_bytes": result_bytes,
             "queue": self.scheduler.stats(),
-            "pool": self.pool.stats() if self.pool is not None else None,
+            # The pool sub-dict carries the distributed-plan coordinator
+            # counters (stage RPCs run, recomputes, speculative backups,
+            # WAL-replay partition reuse — docs/PLAN.md).
+            "pool": (
+                dict(self.pool.stats(), plan=plan_counters)
+                if self.pool is not None else None
+            ),
             "exec_cache": self.executables.stats(),
             "result_cache": self.results.stats(),
             "warm": self.warm.stats() if self.warm is not None else None,
@@ -1146,15 +1169,40 @@ class ServeDaemon:
         return (self.executables.engine_key(job.spec), job.bucket)
 
     def _shardable(self, job: Job) -> bool:
-        # Plan jobs never shard or place remotely: the worker serve
-        # surface speaks (workload, config) batches, and a multi-stage
-        # plan's intermediate state lives in its compiled executor —
-        # the local engine is their floor AND ceiling (docs/PLAN.md).
+        # Plan jobs take their OWN distribution path (_plan_distributable
+        # -> _dispatch_plan_distributed): the worker serve surface here
+        # speaks (workload, config) batches, not plan stages.
         return (
             self.pool is not None
             and job.spec.plan is None
             and self.cfg.shard_max >= 2
             and job.n_blocks >= self.cfg.shard_min_blocks
+        )
+
+    def _plan_shape(self, job: Job):
+        """The distributable map->shuffle->reduce spine of a plan job,
+        or None when the plan is not one of the covered shapes
+        (plan/distribute.py, docs/PLAN.md "Distributed execution")."""
+        if job.spec.plan is None:
+            return None
+        try:
+            from locust_tpu.plan import distribute, from_json
+
+            return distribute.plan_shape(from_json(job.spec.plan))
+        except Exception:  # noqa: BLE001 - unrecognized plan = solo path
+            return None
+
+    def _plan_distributable(self, job: Job) -> bool:
+        """Large plan jobs whose DAG matches a covered shape fan their
+        stages across the pool; everything else keeps the solo engine —
+        the floor, and the byte-identity anchor the distributed path is
+        measured against (docs/PLAN.md "Distributed execution")."""
+        return (
+            self.pool is not None
+            and job.spec.plan is not None
+            and self.cfg.shard_max >= 2
+            and job.n_blocks >= self.cfg.shard_min_blocks
+            and self._plan_shape(job) is not None
         )
 
     def _dispatch_loop(self) -> None:
@@ -1243,6 +1291,21 @@ class ServeDaemon:
                 try:
                     self._shard_executor.submit(
                         self._dispatch_sharded, jobs[0], corpora
+                    )
+                except RuntimeError:  # executor shut down under us
+                    self._fail_batch(jobs, structured_error(
+                        "shutting_down",
+                        "daemon shut down before this job was "
+                        "dispatched; resubmit after it returns",
+                    ))
+                continue
+            if len(jobs) == 1 and self._plan_distributable(jobs[0]):
+                # Same coordinator stance as sharding: the plan
+                # coordinator blocks (bounded) on its stage futures and
+                # must not park the dispatcher.
+                try:
+                    self._shard_executor.submit(
+                        self._dispatch_plan_distributed, jobs[0], corpora
                     )
                 except RuntimeError:  # executor shut down under us
                     self._fail_batch(jobs, structured_error(
@@ -1654,6 +1717,378 @@ class ServeDaemon:
             "overflow_tokens": int(res["overflow_tokens"]),
         }
 
+    def _run_plan_stage_rpc(self, worker, req: dict, phase: str) -> dict:
+        """One plan stage RPC on one worker (pool executor).  Raises
+        ``PoolDispatchError`` on ANY failure — transport death, a
+        structured worker answer (carrying code/epoch/lost_split), an
+        injected fault — the coordinator's wave runner owns recovery."""
+        # Worker-scoped chaos fire (the serve.dispatch shard mold):
+        # models THIS stage RPC dying in flight, coordinator side.
+        rule = faultplan.fire(
+            "plan.stage", phase=phase, worker=worker.name,
+            split=req.get("split"), part=req.get("part"),
+        )
+        if rule is not None:
+            if rule.action == "delay":
+                time.sleep(rule.delay_s)
+            else:
+                raise PoolDispatchError(
+                    f"[faultplan] injected plan stage {rule.action} on "
+                    f"worker {worker.name}"
+                )
+        with obs.span(
+            "plan.stage", phase=phase, worker=worker.name,
+            split=req.get("split"), part=req.get("part"),
+        ):
+            return self.pool.stage_rpc(worker, req)
+
+    def _dispatch_plan_distributed(self, job: Job, corpora: dict) -> None:
+        """Fan one covered-shape plan across the pool as stage programs
+        (docs/PLAN.md "Distributed execution").
+
+        Map wave: each contiguous block-aligned source split folds on a
+        worker's warm executables and publishes its shuffle partitions
+        atomically into the content-addressed spill.  Reduce wave: each
+        partition's inputs move worker-to-worker over the binary data
+        plane and combine on the reducing worker.  Finalize folds the
+        reduced partitions into the solo renderer's EXACT bytes on the
+        daemon — byte-identity to the solo engine is the contract.
+
+        Robustness is STAGE-granular: a failed/dead worker's stage
+        recomputes on a survivor from its durable inputs (never a
+        full-plan restart; a reduce that lost a partition names the
+        ``lost_split`` and exactly that map split recomputes),
+        stragglers past ``plan_speculate_s`` get one speculative backup
+        (first finisher wins — attempt-keyed filenames cannot collide),
+        completed map splits journal as stage-progress records so a
+        daemon restart reuses surviving partitions, and every stage RPC
+        carries the fencing epoch so a zombie coordinator's publishes
+        die structured ``stale_epoch``.  Fewer than 2 placeable workers
+        (or any unrecognized shape upstream) = the solo floor.
+        """
+        from locust_tpu.plan import distribute
+        from locust_tpu.serve import pool as pool_mod
+
+        shape = self._plan_shape(job)
+        cfg = job.spec.cfg
+        corpus = corpora.get(job.corpus_digest, b"")
+        plan_fp = job.spec.plan_fingerprint()
+        ranges = pool_mod.shard_ranges(
+            job.n_lines, cfg.block_lines, self.cfg.shard_max
+        )
+        placements: list = []
+        used: set[int] = set()
+        part_files: set[str] = set()
+        try:
+            akey = self._affinity_key(job)
+            if shape is not None and len(ranges) >= 2:
+                for _ in ranges:
+                    w = self.pool.place(akey, exclude=used)
+                    if w is None:
+                        break
+                    used.add(w.idx)
+                    placements.append(w)
+            if len(placements) < 2:
+                for w in placements:
+                    self.pool.release(w)
+                placements = []
+                self._dispatch_local([job], corpora)
+                return
+            if len(placements) < len(ranges):
+                # Same reconciliation as sharding: re-derive the splits
+                # for the workers we actually hold — never drop lines.
+                ranges = pool_mod.shard_ranges(
+                    job.n_lines, cfg.block_lines, len(placements)
+                )
+                for w in placements[len(ranges):]:
+                    self.pool.release(w)
+                placements = placements[: len(ranges)]
+            n_splits = len(ranges)
+            n_parts = len(placements)
+            job.shards = n_splits
+            job.placed_on = "plan:" + ",".join(w.name for w in placements)
+            self.pool.spill(job.corpus_digest, corpus)
+            dead: set[int] = set()
+            rr = 0
+
+            def next_worker():
+                nonlocal rr
+                for _ in range(len(placements)):
+                    w = placements[rr % len(placements)]
+                    rr += 1
+                    if w.idx not in dead:
+                        return w
+                return None
+
+            def build_map_req(split: int, attempt: int) -> dict:
+                a, b = ranges[split]
+                return {
+                    "phase": "map", "fold": shape.fold,
+                    "config": job.config_overrides or {},
+                    "sha": job.corpus_digest,
+                    "spill_dir": self.pool.spill_dir,
+                    "plan_fp": plan_fp, "split": split,
+                    "attempt": attempt, "n_parts": n_parts,
+                    "line_start": a, "line_end": b,
+                    "lines_per_doc": shape.lines_per_doc,
+                }
+
+            map_done: dict[int, dict] = {}
+
+            def journal_stage(split: int, reply: dict) -> None:
+                if self.journal is not None:
+                    self.journal.append_stage(job.job_id, {
+                        "split": split,
+                        "attempt": int(reply.get("attempt", 0)),
+                        "worker": reply.get("worker", ""),
+                        "n_parts": n_parts,
+                        "truncated": bool(reply.get("truncated")),
+                        "overflow_tokens": int(
+                            reply.get("overflow_tokens", 0)
+                        ),
+                        "parts": reply.get("parts", []),
+                    })
+
+            # WAL-replayed stage progress: reuse a completed split when
+            # the partition layout matches and every file survived with
+            # its recorded sha — a restart RESUMES the plan instead of
+            # remapping everything (anything damaged just recomputes).
+            with self._lock:
+                progress = self._plan_progress.pop(job.job_id, [])
+            for st in progress:
+                try:
+                    s = int(st.get("split", -1))
+                    parts = list(st.get("parts") or [])
+                    if (not 0 <= s < n_splits or s in map_done
+                            or int(st.get("n_parts", -1)) != n_parts
+                            or len(parts) != n_parts):
+                        continue
+                    for ref in parts:
+                        with open(str(ref["path"]), "rb") as f:
+                            data = f.read()
+                        if (hashlib.sha256(data).hexdigest()
+                                != ref["sha256"]):
+                            raise ValueError("partition sha drifted")
+                except Exception:  # noqa: BLE001 - damaged = recompute
+                    continue
+                map_done[s] = dict(st)
+                part_files.update(str(p["path"]) for p in parts)
+                with self._lock:
+                    self._plan_counters["partitions_reused"] += n_parts
+
+            def run_wave(phase, task_ids, build_req, repair=None,
+                         on_win=None):
+                """One wave of stage RPCs: per-task retry (capped),
+                straggler speculation (first finisher wins), rotation
+                over the surviving held placements."""
+                pending: dict = {}
+                won: dict[int, dict] = {}
+                attempts = {t: 0 for t in task_ids}
+                started: dict[int, float] = {}
+                speculated: set[int] = set()
+                deadline = (
+                    time.monotonic() + self.cfg.pool_rpc_timeout + 30.0
+                )
+
+                def launch(task):
+                    w = next_worker()
+                    if w is None:
+                        raise PoolDispatchError(
+                            "no surviving plan-stage workers"
+                        )
+                    fut = self.pool.submit(
+                        self._run_plan_stage_rpc, w,
+                        build_req(task, attempts[task]), phase,
+                    )
+                    attempts[task] += 1
+                    started[task] = time.monotonic()
+                    pending[fut] = (task, w)
+
+                for t in task_ids:
+                    launch(t)
+                while len(won) < len(task_ids):
+                    if time.monotonic() > deadline:
+                        raise PoolDispatchError(
+                            f"plan {phase} wave still inflight after "
+                            f"{self.cfg.pool_rpc_timeout + 30.0:.0f}s"
+                        )
+                    done_f, _ = concurrent.futures.wait(
+                        list(pending), timeout=0.25,
+                        return_when=concurrent.futures.FIRST_COMPLETED,
+                    )
+                    for fut in done_f:
+                        task, w = pending.pop(fut)
+                        try:
+                            reply = fut.result(timeout=1.0)
+                        except Exception as e:  # noqa: BLE001 - per-task retry
+                            if getattr(e, "code", None) == "stale_epoch":
+                                raise  # the outer fence handler owns it
+                            if task in won:
+                                continue  # a speculative loser died
+                            dead.add(w.idx)
+                            if attempts[task] >= 3 \
+                                    or next_worker() is None:
+                                raise
+                            with self._lock:
+                                self._plan_counters["recomputes"] += 1
+                            obs.metric_inc("plan.recomputes")
+                            if repair is not None:
+                                repair(task, e)
+                            launch(task)
+                            continue
+                        if reply.get("parts"):
+                            part_files.update(
+                                str(p["path"]) for p in reply["parts"]
+                            )
+                        if task in won:
+                            continue  # first finisher already won
+                        won[task] = reply
+                        with self._lock:
+                            self._plan_counters["stages"] += 1
+                        if on_win is not None:
+                            on_win(task, reply, w)
+                    now = time.monotonic()
+                    for t in task_ids:
+                        if (t in won or t in speculated
+                                or now - started[t]
+                                <= self.cfg.plan_speculate_s
+                                or next_worker() is None):
+                            continue
+                        speculated.add(t)
+                        with self._lock:
+                            self._plan_counters["speculated"] += 1
+                        obs.metric_inc("plan.speculated")
+                        launch(t)
+                return won
+
+            def on_map_win(split, reply, w):
+                journal_stage(split, reply)
+                self.pool.mark_warm(w, akey)
+
+            todo = [s for s in range(n_splits) if s not in map_done]
+            if todo:
+                map_done.update(run_wave(
+                    "map", todo, build_map_req, on_win=on_map_win,
+                ))
+            truncated = any(
+                bool(r.get("truncated")) for r in map_done.values()
+            )
+            overflow = sum(
+                int(r.get("overflow_tokens", 0))
+                for r in map_done.values()
+            )
+            # The shuffle-partition chaos window (docs/FAULTS.md): the
+            # published files sit durable between the waves — exactly
+            # where a GC race or disk loss would bite a real deployment.
+            for s in sorted(map_done):
+                for ref in map_done[s].get("parts", []):
+                    distribute.chaos_partition(
+                        str(ref["path"]), s, int(ref["part"])
+                    )
+            key_width = distribute.partition_key_width(cfg, shape.fold)
+
+            def build_reduce_req(part: int, attempt: int) -> dict:
+                return {
+                    "phase": "reduce", "part": part,
+                    "key_width": key_width,
+                    "attempt": attempt,
+                    "inputs": [
+                        dict(
+                            map_done[s]["parts"][part], split=s,
+                            worker=map_done[s].get("worker", ""),
+                        )
+                        for s in range(n_splits)
+                    ],
+                }
+
+            def repair_reduce(part: int, exc) -> None:
+                """A reduce attempt lost a partition input: recompute
+                exactly that map split (attempt-bumped, on a survivor)
+                and re-journal it — the relaunched reduce reads the
+                fresh refs through build_reduce_req's closure."""
+                s = getattr(exc, "lost_split", None)
+                if s is None:
+                    return
+                s = int(s)
+                w = next_worker()
+                if w is None:
+                    raise PoolDispatchError(
+                        "no surviving plan-stage workers"
+                    )
+                attempt = int(map_done[s].get("attempt", 0)) + 1
+                reply = self._run_plan_stage_rpc(
+                    w, build_map_req(s, attempt), "map"
+                )
+                part_files.update(
+                    str(p["path"]) for p in reply.get("parts", [])
+                )
+                map_done[s] = reply
+                journal_stage(s, reply)
+
+            reduce_done = run_wave(
+                "reduce", list(range(n_parts)), build_reduce_req,
+                repair=repair_reduce,
+            )
+            partition_pairs = [
+                [
+                    (base64.b64decode(k), int(v))
+                    for k, v in reduce_done[p].get("pairs", [])
+                ]
+                for p in range(n_parts)
+            ]
+            # Finalize is device work (the wordcount re-merge) on the
+            # coordinator thread: it serializes with every other local
+            # device touch.
+            with self._engine_lock:
+                output, distinct, trunc, ovf = distribute.finalize(
+                    shape, cfg, job.n_lines, partition_pairs,
+                    truncated, overflow,
+                )
+            self._finish_job(
+                job, [(output, 0)], distinct, trunc, ovf,
+                "distributed", time.monotonic(),
+            )
+        except PlanError as e:
+            # Deterministic rejection — same bad_spec discipline as the
+            # solo plan path (retrying cannot change the answer).
+            self._fail_batch([job], structured_error(
+                "bad_spec",
+                f"plan execution rejected the corpus: {e}",
+            ))
+        except Exception as e:  # noqa: BLE001 - retry ladder absorbs it
+            logger.warning(
+                "distributed plan dispatch of %s failed: %s: %s",
+                job.job_id, type(e).__name__, e,
+            )
+            if getattr(e, "code", None) == "stale_epoch":
+                # A worker has served a NEWER primary: we are the
+                # fenced-out zombie — no stale partition may publish.
+                worker_epoch = getattr(e, "epoch", None)
+                with self._lock:
+                    fence = max(
+                        self._seen_epoch, self.epoch + 1,
+                        int(worker_epoch or 0),
+                    )
+                self._demote(fence)
+            self._retry_or_fail(
+                [job], corpora,
+                f"distributed plan: {type(e).__name__}: {e}",
+            )
+        finally:
+            # Held for the whole run (each worker serves several stage
+            # RPCs); a straggler RPC still in flight past this release
+            # is bounded by the worker's own rpc timeout.
+            for w in placements:
+                self.pool.release(w)
+            # Shuffle partitions are scaffolding once the job settled —
+            # the fsync'd admit record can always re-run the plan — so
+            # drop them best-effort to keep the spill dir from accreting.
+            for p in part_files:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
     def _finish_job(
         self, job: Job, pairs: list, distinct, truncated, overflow,
         cache_label: str, done: float,
@@ -2040,6 +2475,12 @@ class ServeDaemon:
             with self._lock:
                 self._remember(job)
                 self._corpus_put(job.job_id, corpus)
+                if entry.stages and job.spec.plan is not None:
+                    # Stage-progress records (distributed plans): the
+                    # coordinator re-verifies each recorded partition
+                    # file by sha and reuses the survivors instead of
+                    # remapping the whole plan (docs/PLAN.md).
+                    self._plan_progress[job.job_id] = list(entry.stages)
             self.scheduler.requeue(job, 0.0)
             if entry.terminal is not None:
                 # A done-but-unpersisted job re-enqueues past its own

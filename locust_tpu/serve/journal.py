@@ -99,13 +99,16 @@ def admit_record(job) -> dict:
 
 
 class JournalEntry:
-    """One replayable job: its admit record + last terminal state."""
+    """One replayable job: its admit record + last terminal state +
+    any distributed-plan stage-progress records (docs/PLAN.md
+    "Distributed execution") journaled before the crash."""
 
-    __slots__ = ("admit", "terminal")
+    __slots__ = ("admit", "terminal", "stages")
 
     def __init__(self, admit: dict, terminal: dict | None = None):
         self.admit = admit
         self.terminal = terminal
+        self.stages: list[dict] = []
 
 
 class JobJournal:
@@ -202,6 +205,19 @@ class JobJournal:
                "t": time.time()}
         if error is not None:
             rec["error"] = dict(error)
+        self._append(rec, durable=False)
+
+    def append_stage(self, job_id: str, stage: dict) -> None:
+        """Flush-only stage-progress record for a distributed plan
+        (docs/PLAN.md "Distributed execution"): one per completed map
+        split, carrying its published partition references.  Replay
+        hands them back so a restarted coordinator RESUMES from the
+        splits whose partitions survived on disk instead of re-running
+        the whole map wave.  Flush-only like state records: losing one
+        to a crash only costs a recompute — the fsync'd admit record
+        (which carries the whole plan) already guarantees the answer."""
+        rec = {"rec": "stage", "job_id": job_id, "stage": dict(stage),
+               "t": time.time()}
         self._append(rec, durable=False)
 
     def _append(self, rec: dict, durable: bool) -> None:
@@ -359,6 +375,11 @@ class JobJournal:
                         raise ValueError(f"bad state {rec['state']!r}")
                     if job_id in entries:
                         entries[job_id].terminal = rec
+                elif kind == "stage":
+                    if not isinstance(rec.get("stage"), dict):
+                        raise ValueError("stage record without a stage")
+                    if job_id in entries:
+                        entries[job_id].stages.append(rec["stage"])
                 else:
                     raise ValueError(f"unknown record type {kind!r}")
             except (ValueError, KeyError, TypeError) as e:

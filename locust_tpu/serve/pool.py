@@ -67,9 +67,13 @@ class PoolDispatchError(RuntimeError):
     primary must react to, not merely retry (docs/SERVING.md)."""
 
     def __init__(self, message: str, code: str | None = None,
-                 epoch: int | None = None):
+                 epoch: int | None = None, lost_split: int | None = None):
         self.code = code
         self.epoch = epoch  # the rejecting side's fencing epoch, if sent
+        # A reduce stage naming the map split whose partition input it
+        # lost: the plan coordinator recomputes exactly that split
+        # (docs/PLAN.md "Distributed execution"), not the whole plan.
+        self.lost_split = lost_split
         super().__init__(message)
 
 
@@ -428,9 +432,37 @@ class WorkerPool:
         self.health.ok(worker.idx)
         return reply
 
+    def stage_rpc(self, worker: PoolWorker, req: dict) -> dict:
+        """One distributed-plan stage RPC on ``worker`` (docs/PLAN.md
+        "Distributed execution"): the plan coordinator's map-split and
+        reduce-partition dispatches ride this.  Epoch-stamped exactly
+        like ``dispatch`` — a fenced-out zombie primary's stage can
+        never publish a stale partition.  Raises ``PoolDispatchError``
+        on ANY failure after marking the worker's health; a reduce
+        stage's structured loss report surfaces as ``lost_split``."""
+        req = dict(req, cmd="plan_stage")
+        if self.epoch_fn is not None:
+            req[protocol.EPOCH_KEY] = int(self.epoch_fn())
+        try:
+            reply = worker.rpc(req, self.secret, self.rpc_timeout)
+        except Exception as e:
+            self._dispatch_failed(
+                worker,
+                f"stage rpc died ({type(e).__name__}: {e})",
+                cause=e,
+            )
+        if reply.get("status") != "ok":
+            self._dispatch_failed(
+                worker, f"answered: {reply.get('error')}",
+                code=reply.get("code"), epoch=reply.get("epoch"),
+                lost_split=reply.get("lost_split"),
+            )
+        self.health.ok(worker.idx)
+        return reply
+
     def _dispatch_failed(
         self, worker: PoolWorker, msg: str, cause=None, code=None,
-        epoch=None,
+        epoch=None, lost_split=None,
     ):
         """The ONE failure path out of ``dispatch``: quarantine the
         worker, count it, raise for the caller's retry ladder."""
@@ -441,6 +473,7 @@ class WorkerPool:
             f"worker {worker.name} {msg}",
             code=str(code) if code else None,
             epoch=int(epoch) if epoch is not None else None,
+            lost_split=int(lost_split) if lost_split is not None else None,
         )
         if cause is not None:
             raise err from cause

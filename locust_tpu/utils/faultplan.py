@@ -127,6 +127,24 @@ SITES = {
     # resumes on CPU from the last checkpoint; "delay" models a slow
     # tunnel.  ctx: block, backend.
     "backend.dispatch": ("error", "delay"),
+    # plan.stage fires at the distributed-plan stage RPC boundary, on
+    # BOTH sides (distributor/worker.py _plan_stage and the daemon's
+    # _run_plan_stage_rpc; docs/PLAN.md "Distributed execution"):
+    # "crash" models the worker SIGKILL'd mid-stage (connection dropped,
+    # no reply — the coordinator recomputes the stage on a survivor);
+    # "error" a structured stage failure (same recovery); "delay" a
+    # straggler the coordinator's speculative backup races.  ctx: phase
+    # (map|reduce), split, part, plus port on the worker-side fire and
+    # worker on the daemon-side fire.
+    "plan.stage": ("crash", "error", "delay"),
+    # plan.partition fires between the map and reduce waves on every
+    # published shuffle-partition file (plan/distribute.py
+    # chaos_partition): "drop" unlinks it (a spill GC race / disk loss
+    # mid-plan — the reduce worker's read fails, names the lost_split,
+    # and the coordinator recomputes exactly that map split); "corrupt"
+    # flips bytes (the sha256 gate rejects the file — same recovery,
+    # never a silent wrong answer).  ctx: path, split, part.
+    "plan.partition": ("drop", "corrupt"),
 }
 
 _RULE_KEYS = {"site", "action", "match", "times", "after", "prob", "delay_s"}

@@ -116,6 +116,16 @@ def main(argv=None) -> int:
         timeout=300,
     ).returncode
 
+    # Distributed-plan smoke (docs/PLAN.md "Distributed execution"): the
+    # same two-stage tfidf plan across TWO real --serve workers, one
+    # SIGKILL'd mid-map-stage (held open by an injected delay), and the
+    # answer must STILL be byte-identical to the one-shot tfidf CLI —
+    # stage-granular recompute on the survivor, never a wrong answer.
+    dplan_rc = subprocess.run(
+        [sys.executable, "-c", _DPLAN_SMOKE], cwd=REPO, env=env,
+        timeout=420,
+    ).returncode
+
     # Machine-death failover smoke (docs/SERVING.md "High
     # availability"): a REAL primary+standby pair, the primary
     # SIGKILL'd holding a wordcount AND a journaled plan job, the
@@ -130,11 +140,13 @@ def main(argv=None) -> int:
         f"[check] tests: rc={proc.returncode}; analysis rc={rc}; "
         f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
         f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}; "
-        f"plan smoke rc={plan_rc}; failover smoke rc={failover_rc}",
+        f"plan smoke rc={plan_rc}; dplan smoke rc={dplan_rc}; "
+        f"failover smoke rc={failover_rc}",
         file=sys.stderr,
     )
     return (rc or proc.returncode or trace_rc or serve_rc
-            or recovery_rc or pool_rc or plan_rc or failover_rc)
+            or recovery_rc or pool_rc or plan_rc or dplan_rc
+            or failover_rc)
 
 
 _TRACE_ROUNDTRIP = """
@@ -453,6 +465,94 @@ finally:
 print("[check] plan smoke ok (two-stage tfidf plan byte-identical to "
       "the one-shot CLI, repeat = plan-keyed result-cache hit)",
       file=sys.stderr)
+"""
+
+
+_DPLAN_SMOKE = """
+import json, os, signal, subprocess, sys, tempfile, time
+
+td = tempfile.mkdtemp(prefix="locust_dplan_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write(b"alpha beta gamma\\nbeta gamma delta\\nalpha alpha\\n"
+            b"epsilon zeta\\n" * 8)
+cfg_flags = ["--block-lines", "8", "--line-width", "64",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "PYTHONPATH": os.getcwd(), "LOCUST_SECRET": "dplan-smoke"}
+
+# The oracle: the one-shot hand-wired tfidf CLI over the same corpus.
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", "tfidf", corpus_path,
+     "--backend", "cpu", "--lines-per-doc", "2"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+
+from locust_tpu.plan import tfidf_plan
+
+plan_path = os.path.join(td, "tfidf_plan.json")
+with open(plan_path, "w") as f:
+    json.dump(tfidf_plan(2).to_doc(), f)
+
+def spawn_worker(fault=None):
+    wenv = dict(env)
+    if fault is not None:
+        wenv["LOCUST_FAULT_PLAN"] = json.dumps(fault)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.distributor.worker",
+         "--serve", "--port", "0"],
+        env=wenv, stderr=subprocess.PIPE, text=True,
+    )
+    line = proc.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    return proc, f"{host}:{port}"
+
+# w2 holds its first map stage open 6s: the SIGKILL below provably
+# lands MID-stage, and the coordinator must recompute that split on
+# the survivor from the durable corpus spill.
+w1, a1 = spawn_worker()
+w2, a2 = spawn_worker(fault={"seed": 7, "rules": [
+    {"site": "plan.stage", "action": "delay", "delay_s": 6.0,
+     "match": {"phase": "map"}, "times": 1}]})
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "locust_tpu.serve", "--port", "0",
+     "--workers", f"{a1},{a2}", "--shard-min-blocks", "1"],
+    env=env, stderr=subprocess.PIPE, text=True,
+)
+try:
+    line = daemon.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    submit = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.serve", "submit",
+         corpus_path, "--plan", plan_path, "--port", port] + cfg_flags,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    time.sleep(1.5)
+    w2.send_signal(signal.SIGKILL)
+    w2.wait(timeout=10)
+    out, err = submit.communicate(timeout=240)
+    assert submit.returncode == 0, err[-800:]
+    assert out == one_shot.stdout, (
+        "distributed plan != one-shot tfidf CLI\\n%r\\n%r"
+        % (out[:200], one_shot.stdout[:200])
+    )
+    from locust_tpu.serve.client import ServeClient
+    client = ServeClient((host, int(port)), b"dplan-smoke", timeout=60.0)
+    pl = client.stats()["pool"]["plan"]
+    assert pl["stages"] >= 4, pl      # it really ran distributed
+    assert pl["recomputes"] >= 1, pl  # and really lost a stage
+    client.shutdown()
+    daemon.wait(timeout=60)
+finally:
+    for p in (w1, w2, daemon):
+        if p.poll() is None:
+            p.kill()
+print("[check] dplan smoke ok (tfidf plan across 2 real workers; "
+      "SIGKILL mid-map-stage -> survivor recompute, byte-identical "
+      "to the one-shot CLI)", file=sys.stderr)
 """
 
 

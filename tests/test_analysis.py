@@ -303,6 +303,7 @@ def test_r004_mutating_real_sites_registry_fails_the_gate(tmp_path):
         "locust_tpu/serve/pool.py",     # hooks serve.place
         "locust_tpu/serve/replicate.py",  # hooks serve.ship
         "locust_tpu/backend.py",        # hooks backend.dispatch
+        "locust_tpu/plan/distribute.py",  # hooks plan.partition (chaos_partition)
         "locust_tpu/ops/pallas/fused_fold.py",  # hot-path kernel: site-free
         "tests/test_faults.py",
         "docs/FAULTS.md",
@@ -620,6 +621,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/serve/replicate.py",  # emits serve.ship/ship_lag
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
         "locust_tpu/plan/compile.py",   # emits plan.compile/plan.run
+        "locust_tpu/plan/distribute.py",  # emits plan.partition_bytes
         "locust_tpu/ops/pallas/fused_fold.py",  # kernel: must stay name-free
     ):
         dst = tmp_path / rel

@@ -1446,6 +1446,150 @@ def test_chaos_serve_pool_worker_death_mid_batch_recovers_exact():
             _shutdown(w)
 
 
+# ------------------------------------ distributed plan execution (ISSUE 16)
+#
+# Plan jobs fan map/reduce stages across the pool with a cross-worker
+# shuffle (plan/distribute.py; docs/PLAN.md "Distributed execution").
+# The same guarantee, STAGE-granular: an injected stage failure, a real
+# worker crash mid-stage-RPC, a shuffle partition lost or corrupted
+# between the waves, and a fenced zombie's stage publish all end
+# byte-identical (stage recompute on a survivor / solo floor) or
+# structured — never a silent wrong answer, never a full-plan restart.
+
+
+def _dplan_rig(**cfg_kw):
+    return _serve_pool_rig(shard_min_blocks=1, **cfg_kw)
+
+
+def _dplan_oracle() -> bytes:
+    from locust_tpu.config import EngineConfig
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.plan.compile import compile_plan
+
+    return compile_plan(
+        tfidf_plan(2), EngineConfig(**SERVE_CFG)
+    ).run_corpus(SERVE_CORPUS).output
+
+
+def _dplan_submit(client, timeout=60.0):
+    from locust_tpu.plan import tfidf_plan
+
+    ack = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                        plan=tfidf_plan(2).to_doc(), no_cache=True)
+    return ack, client.wait(ack["job_id"], timeout=timeout)
+
+
+def test_chaos_plan_stage_error_recomputes_on_survivor_exact():
+    """plan.stage error: one injected stage failure mid-plan — the
+    coordinator recomputes that stage on a survivor (never restarts the
+    plan) and the distributed result stays byte-identical to solo."""
+    daemon, workers, client = _dplan_rig()
+    try:
+        p = plan([{"site": "plan.stage", "action": "error",
+                   "match": {"phase": "map"}, "times": 1}])
+        with faultplan.active_plan(p):
+            ack, res = _dplan_submit(client)
+        assert res["pairs"][0][0] == _dplan_oracle()
+        assert p.rules[0].fired == 1
+        st = client.status(ack["job_id"])
+        assert st["state"] == "done"
+        assert st["placed_on"].startswith("plan:")
+        assert client.stats()["pool"]["plan"]["recomputes"] >= 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_plan_stage_worker_crash_mid_stage_recovers_exact():
+    """plan.stage crash scoped to ONE worker's port: that worker's
+    connection drops mid-stage-RPC with no reply (the SIGKILL model) —
+    the coordinator marks it dead for this plan, recomputes the stage
+    on the survivor, and the result stays exact."""
+    daemon, workers, client = _dplan_rig()
+    try:
+        p = plan([{"site": "plan.stage", "action": "crash",
+                   "match": {"port": workers[0].addr[1]}, "times": 1}])
+        with faultplan.active_plan(p):
+            ack, res = _dplan_submit(client)
+        assert res["pairs"][0][0] == _dplan_oracle()
+        assert p.rules[0].fired == 1
+        st = client.status(ack["job_id"])
+        assert st["state"] == "done"
+        assert client.stats()["pool"]["plan"]["recomputes"] >= 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_plan_partition_drop_recomputes_split_exact():
+    """plan.partition drop: a shuffle partition file vanishes between
+    the map and reduce waves (spill GC race / disk loss).  The reduce
+    worker's read fails naming the lost_split, the coordinator
+    recomputes exactly that map split from the durable corpus spill —
+    a recompute, never a wrong answer."""
+    daemon, workers, client = _dplan_rig()
+    try:
+        p = plan([{"site": "plan.partition", "action": "drop",
+                   "times": 1}])
+        with faultplan.active_plan(p):
+            ack, res = _dplan_submit(client)
+        assert res["pairs"][0][0] == _dplan_oracle()
+        assert p.rules[0].fired == 1
+        assert client.status(ack["job_id"])["state"] == "done"
+        assert client.stats()["pool"]["plan"]["recomputes"] >= 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_plan_partition_corrupt_detected_and_recomputed_exact():
+    """plan.partition corrupt: flipped bytes in a published partition
+    are caught by the sha256 gate on read (a torn file can never fold)
+    — same lost_split recovery, byte-identical result."""
+    daemon, workers, client = _dplan_rig()
+    try:
+        p = plan([{"site": "plan.partition", "action": "corrupt",
+                   "times": 1}])
+        with faultplan.active_plan(p):
+            ack, res = _dplan_submit(client)
+        assert res["pairs"][0][0] == _dplan_oracle()
+        assert p.rules[0].fired == 1
+        assert client.stats()["pool"]["plan"]["recomputes"] >= 1
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
+def test_chaos_plan_stage_stale_epoch_publish_fenced():
+    """Zombie stage publish: every pool worker has served a NEWER
+    primary (their fencing guards sit above this daemon's epoch), so
+    the zombie coordinator's first stage RPC answers structured
+    stale_epoch — no stale partition is accepted — and the daemon
+    demotes itself to standby instead of split-braining."""
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.serve import ServeError
+
+    daemon, workers, client = _dplan_rig()
+    try:
+        for w in workers:
+            w._epoch_guard.observe(daemon.epoch + 7)
+        ack = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                            plan=tfidf_plan(2).to_doc(), no_cache=True,
+                            max_attempts=1)
+        with pytest.raises(ServeError):
+            client.wait(ack["job_id"], timeout=60.0)
+        assert daemon.role == "standby"
+        assert daemon._seen_epoch >= daemon.epoch + 7
+    finally:
+        daemon.close()
+        for w in workers:
+            _shutdown(w)
+
+
 def test_chaos_serve_journal_plan_job_replays_byte_identical(tmp_path):
     """Chaos-matrix row for PLAN jobs (docs/PLAN.md): an admitted plan
     job — the WAL admit record carries the whole plan document — is

@@ -1820,6 +1820,262 @@ def test_daemon_plan_job_replays_from_journal(tmp_path):
         d2.close()
 
 
+# ---------------------------------- distributed plan execution (ISSUE 16)
+#
+# The plan layer's scale-out path (daemon._dispatch_plan_distributed +
+# plan/distribute.py + the workers' plan_stage surface): shape
+# recognition units, the atomic partition spill format, distributed-vs-
+# solo byte identity for every covered fold, the local-engine floor, and
+# WAL-replay resume from journaled stage records (docs/PLAN.md
+# "Distributed execution").  The chaos side — stage crash/error/delay,
+# partition drop/corrupt, stale-epoch fencing — lives in
+# tests/test_faults.py.
+
+
+def _compiled_oracle(plan, corpus: bytes) -> bytes:
+    from locust_tpu.plan.compile import compile_plan
+
+    return compile_plan(plan, CFG).run_corpus(corpus).output
+
+
+def test_distribute_plan_shape_recognizes_covered_spines():
+    """plan_shape answers the distributable spine for exactly the three
+    covered folds and None for everything else (None = the solo path,
+    byte-identical by refusal — never an error)."""
+    from locust_tpu.plan import (
+        index_plan,
+        pagerank_plan,
+        tfidf_plan,
+        wordcount_plan,
+    )
+    from locust_tpu.plan.distribute import plan_shape
+    from locust_tpu.plan.nodes import Plan, node
+
+    wc = plan_shape(wordcount_plan())
+    assert (wc.fold, wc.score, wc.sink_op) == ("wordcount", False, "table")
+    tf = plan_shape(tfidf_plan(2))
+    assert (tf.fold, tf.lines_per_doc, tf.score, tf.sink_op) == \
+        ("tf", 2, True, "tfidf")
+    ix = plan_shape(index_plan(3))
+    assert (ix.fold, ix.lines_per_doc, ix.sink_op) == ("index", 3, "postings")
+    assert plan_shape(pagerank_plan(3)) is None  # iterate: solo only
+    # A joined DAG is valid but not a covered spine: refusal, not error.
+    wide = Plan((
+        node("c1", "source", "text"),
+        node("m1", "map", "tokenize_count", ("c1",)),
+        node("s1", "shuffle", "by_key", ("m1",)),
+        node("r1", "reduce", "sum", ("s1",)),
+        node("c2", "source", "text", input="aux"),
+        node("m2", "map", "tokenize_count", ("c2",)),
+        node("s2", "shuffle", "by_key", ("m2",)),
+        node("r2", "reduce", "sum", ("s2",)),
+        node("j", "join", "inner", ("r1", "r2")),
+        node("out", "sink", "table", ("j",)),
+    ))
+    assert plan_shape(wide) is None
+
+
+def test_distribute_partition_publish_read_roundtrip(tmp_path):
+    """The shuffle spill discipline: composite keys round-trip through
+    the LKVB encode, publish is atomic with a sha over the bytes, every
+    partition file exists (absence means LOSS, not emptiness), and the
+    read gate rejects corrupt or missing files loudly."""
+    from locust_tpu.plan import distribute
+
+    # key codec: raw words for wordcount, word NUL doc for composites.
+    assert distribute.encode_key("wordcount", b"alpha") == b"alpha"
+    enc = distribute.encode_key("tf", (b"alpha", 7))
+    assert distribute.decode_key("tf", enc) == (b"alpha", 7)
+    assert distribute.partition_key_width(CFG, "wordcount") == 16
+    assert distribute.partition_key_width(CFG, "tf") == 16 + 11
+    # The partitioner is deterministic and total.
+    parts = {distribute.partition_of(enc, 4) for _ in range(3)}
+    assert len(parts) == 1 and parts.pop() in range(4)
+
+    pairs = [(distribute.encode_key("tf", (w, d)), c)
+             for w, d, c in ((b"alpha", 0, 3), (b"beta", 1, 2),
+                             (b"gamma", 0, 1), (b"alpha", 1, 5))]
+    refs = distribute.publish_split(str(tmp_path), "fp0", 0, 0, pairs, 3)
+    assert [r["part"] for r in refs] == [0, 1, 2]
+    assert sum(r["pairs"] for r in refs) == len(pairs)
+    got = {}
+    for ref in refs:
+        assert os.path.exists(ref["path"])  # empty partitions included
+        rows = distribute.read_partition(
+            ref["path"], ref["sha256"],
+            distribute.partition_key_width(CFG, "tf"))
+        distribute.merge_pairs(got, rows)
+    assert {distribute.decode_key("tf", k): v for k, v in got.items()} == \
+        {(b"alpha", 0): 3, (b"beta", 1): 2, (b"gamma", 0): 1,
+         (b"alpha", 1): 5}
+    # Corruption trips the sha gate; a vanished file is the same loss.
+    victim = next(r for r in refs if r["pairs"])
+    with open(victim["path"], "r+b") as f:
+        f.write(b"\xff\xff")
+    with pytest.raises(ValueError, match="sha mismatch"):
+        distribute.read_partition(victim["path"], victim["sha256"], 27)
+    os.unlink(victim["path"])
+    with pytest.raises(ValueError, match="unreadable"):
+        distribute.read_partition(victim["path"], victim["sha256"], 27)
+
+
+def test_pool_distributed_plan_byte_identical_every_covered_fold():
+    """The tentpole identity pin: each covered fold's plan submitted
+    against a 2-worker pool runs DISTRIBUTED (placed_on names the
+    workers) and answers byte-for-byte what the solo compiled plan
+    renders over the same corpus."""
+    from locust_tpu.plan import index_plan, tfidf_plan, wordcount_plan
+
+    daemon, ws, client = _pool_rig(shard_min_blocks=1)
+    corpus = CORPUS_A + CORPUS_B
+    try:
+        for plan in (tfidf_plan(2), wordcount_plan(), index_plan(2)):
+            ack = client.submit(corpus=corpus, config=CFG_OVR,
+                                plan=plan.to_doc(), no_cache=True)
+            res = client.wait(ack["job_id"], timeout=120.0)
+            assert res["plan"] is True
+            assert res["pairs"][0][0] == _compiled_oracle(plan, corpus)
+            st = client.status(ack["job_id"])
+            assert st["placed_on"].startswith("plan:")
+        pl = client.stats()["pool"]["plan"]
+        assert pl["stages"] >= 6  # >= (map+reduce) x 3 plans
+        assert pl["recomputes"] == 0 and pl["speculated"] == 0
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
+def test_pool_distributed_plan_local_floor_cases():
+    """Every refusal lands on the solo local engine, never an error:
+    an uncovered shape (pagerank), a job under the shard floor, and a
+    pool with a single live worker (a distributed run needs >= 2)."""
+    from locust_tpu.plan import pagerank_plan, tfidf_plan
+
+    daemon, ws, client = _pool_rig(shard_min_blocks=1)
+    try:
+        edges = b"0 1\n1 2\n2 0\n" * 4
+        plan = pagerank_plan(3)
+        ack = client.submit(corpus=edges, config=CFG_OVR,
+                            plan=plan.to_doc(), no_cache=True)
+        res = client.wait(ack["job_id"], timeout=120.0)
+        assert res["pairs"][0][0] == _compiled_oracle(plan, edges)
+        assert client.status(ack["job_id"])["placed_on"] == "local"
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+    # Under the shard floor: a 2-block corpus with shard_min_blocks=8.
+    daemon, ws, client = _pool_rig(shard_min_blocks=8)
+    try:
+        ack = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                            plan=tfidf_plan(2).to_doc(), no_cache=True)
+        res = client.wait(ack["job_id"], timeout=120.0)
+        assert res["pairs"][0][0] == _compiled_oracle(tfidf_plan(2),
+                                                      CORPUS_A)
+        assert client.status(ack["job_id"])["placed_on"] == "local"
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+    # One worker: the coordinator can't place two stages, releases the
+    # slot and takes the solo floor mid-dispatch.
+    daemon, ws, client = _pool_rig(n_workers=1, shard_min_blocks=1)
+    try:
+        ack = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                            plan=tfidf_plan(2).to_doc(), no_cache=True)
+        res = client.wait(ack["job_id"], timeout=120.0)
+        assert res["pairs"][0][0] == _compiled_oracle(tfidf_plan(2),
+                                                      CORPUS_A)
+        assert client.status(ack["job_id"])["placed_on"] == "local"
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
+def test_journal_stage_records_replay_with_admit():
+    """Unit for the WAL side: stage records are flush-only riders on the
+    fsync'd admit record and replay() hands them back in order on the
+    surviving entry."""
+    import tempfile
+
+    from locust_tpu.serve.journal import JobJournal
+
+    with tempfile.TemporaryDirectory() as jd:
+        j = JobJournal(jd)
+        job = mk_job(job_id="dp1")
+        j.append_admit(job, b"corpus bytes\n")
+        j.append_stage("dp1", {"split": 0, "attempt": 0, "parts": []})
+        j.append_stage("dp1", {"split": 1, "attempt": 0, "parts": []})
+        j.append_stage("ghost", {"split": 9})  # no admit: dropped
+        j.close()
+        entries = JobJournal(jd).replay()
+        by_id = {e.admit["job_id"]: e for e in entries}
+        assert [s["split"] for s in by_id["dp1"].stages] == [0, 1]
+        assert "ghost" not in by_id
+
+
+def test_pool_distributed_plan_wal_replay_resumes_from_stage_records(
+        tmp_path):
+    """Machine-death durability for the distributed path: the daemon is
+    abandoned AFTER the map wave journaled its stage records but before
+    the reduce wave finished.  The restarted daemon's replay resumes the
+    plan from the surviving partitions (partitions_reused counts them)
+    and the answer is byte-identical to the solo compiled plan."""
+    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.utils import faultplan
+
+    jd = str(tmp_path / "journal")
+    mk = dict(max_queue=16, max_batch=4, dispatch_poll_s=0.02,
+              retry_base_s=0.02, journal_dir=jd, shard_min_blocks=1)
+    from locust_tpu.distributor.worker import Worker
+
+    ws = []
+    for _ in range(2):
+        w = Worker(secret=SECRET, serve=True)
+        w.serve_in_thread()
+        ws.append(w)
+    addrs = tuple(f"127.0.0.1:{w.addr[1]}" for w in ws)
+    daemon = ServeDaemon(secret=SECRET,
+                         cfg=ServeConfig(workers=addrs, **mk))
+    daemon.serve_in_thread()
+    client = ServeClient(daemon.addr, SECRET, timeout=60.0)
+    # Stall every reduce-stage RPC on the daemon side: the map wave
+    # lands (stage records + partitions durable), the reduce wave never
+    # does — the abandon models the machine dying mid-shuffle.
+    p = faultplan.FaultPlan(
+        [{"site": "plan.stage", "action": "delay", "delay_s": 60.0,
+          "match": {"phase": "reduce"}, "times": 8}], seed=11,
+    )
+    try:
+        with faultplan.active_plan(p):
+            ack = client.submit(corpus=CORPUS_A, config=CFG_OVR,
+                                plan=tfidf_plan(2).to_doc(), no_cache=True)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with open(daemon.journal.path, "rb") as f:
+                    if f.read().count(b'"rec":"stage"') >= 2:
+                        break
+                time.sleep(0.05)
+            else:
+                pytest.fail("map wave never journaled its stage records")
+            serve_abandon(daemon)
+        d2 = ServeDaemon(secret=SECRET,
+                         cfg=ServeConfig(workers=addrs, **mk))
+        d2.serve_in_thread()
+        c2 = ServeClient(d2.addr, SECRET, timeout=60.0)
+        try:
+            res = c2.wait(ack["job_id"], timeout=120.0)
+            assert res["plan"] is True
+            assert res["pairs"][0][0] == _compiled_oracle(tfidf_plan(2),
+                                                          CORPUS_A)
+            st = c2.status(ack["job_id"])
+            assert st["placed_on"].startswith("plan:")
+            assert c2.stats()["pool"]["plan"]["partitions_reused"] >= 2
+        finally:
+            d2.close()
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
 # --------------------------------------------- high availability (ISSUE 14)
 #
 # WAL shipping to a hot standby + fenced promotion (docs/SERVING.md
