@@ -1,4 +1,4 @@
-"""The closed rule registry (R001–R014) — itself anti-drift-checked:
+"""The closed rule registry (R001–R015) — itself anti-drift-checked:
 ``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
 pins that every registered rule has firing + silent fixture coverage."""
 
@@ -13,7 +13,10 @@ from locust_tpu.analysis.rules_hygiene import (
     SubprocessEnvRule,
     TrackedArtifactRule,
 )
-from locust_tpu.analysis.rules_plan import PlanRegistryRule
+from locust_tpu.analysis.rules_plan import (
+    PlanRegistryRule,
+    RewriteRegistryRule,
+)
 from locust_tpu.analysis.rules_serve import ServeErrorRegistryRule
 from locust_tpu.analysis.rules_telemetry import TelemetryRegistryRule
 from locust_tpu.analysis.rules_threads import (
@@ -42,6 +45,7 @@ _RULE_CLASSES = (
     ThreadLifecycleRule,        # R012
     UnboundedBlockingRule,      # R013
     PlanRegistryRule,           # R014
+    RewriteRegistryRule,        # R015
 )
 
 

@@ -1,4 +1,4 @@
-"""R014 — plan node-kind registry drift (two-sided, the R004/R011 mold).
+"""R014/R015 — plan registry drift (two-sided, the R004/R011 mold).
 
 The plan layer's whole extensibility story is ONE closed registry
 (``locust_tpu/plan/nodes.py`` ``NODE_KINDS``): every dataflow node a
@@ -23,6 +23,17 @@ both sides honest as they do:
     documented in ``docs/PLAN.md`` (backticked) — a kind the compiler
     cannot lower is a validation-passes/dispatch-explodes trap, and an
     untested or undocumented kind is an unanchored contract.
+
+R015 applies the same stance to the optimizer's ``REWRITE_RULES``
+registry (``locust_tpu/plan/optimize.py``): every
+``record_rewrite("rule")`` literal under ``locust_tpu/`` must be a
+registry entry (a typo'd id already fails loudly at runtime — the
+static half catches it before the firing path is ever reached), and
+every entry must be APPLIED in ``plan/optimize.py`` (its literal
+appears outside the registry tuple itself), exercised under ``tests/``
+(quoted) and documented in ``docs/PLAN.md`` (backticked) — a
+registered rewrite nothing fires, tests or documents is a byte-identity
+claim nobody is checking.
 """
 
 from __future__ import annotations
@@ -206,4 +217,140 @@ class PlanRegistryRule(Rule):
                     f"NODE_KINDS entry {kind!r} is undocumented in "
                     f"{self.docs_rel} (backtick the kind in the node "
                     "catalog)",
+                )
+
+
+PLAN_OPTIMIZE_REL = "locust_tpu/plan/optimize.py"
+
+
+def _parse_rewrite_rules(files, root, rel):
+    """The REWRITE_RULES tuple literal: ``({rule: line}, (lo, hi))``
+    where (lo, hi) is the assignment's own line span (its literals are
+    the registry, not applied-side evidence), or ``(None, None)``."""
+    from locust_tpu.analysis.core import parse_registry_module
+
+    tree = parse_registry_module(files, root, rel)
+    if tree is None:
+        return None, None
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "REWRITE_RULES"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            rules = {}
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    rules[elt.value] = elt.lineno
+            return rules, (node.lineno, node.end_lineno or node.lineno)
+    return None, None
+
+
+class RewriteRegistryRule(Rule):
+    rule_id = "R015"
+    title = "plan REWRITE_RULES registry drift"
+
+    # Overridable for fixture trees in tests (the R004/R011/R014 pattern).
+    optimize_rel = PLAN_OPTIMIZE_REL
+    docs_rel = PLAN_DOCS_REL
+    analyzer_tests_rel = "tests/test_analysis.py"
+
+    def check_project(self, files, root):
+        rules, span = _parse_rewrite_rules(files, root, self.optimize_rel)
+        if rules is None:
+            yield Finding(
+                self.rule_id, self.optimize_rel, 1, 0,
+                "cannot parse the REWRITE_RULES registry (module missing "
+                "or no module-level `REWRITE_RULES = (...)` tuple "
+                "literal)",
+            )
+            return
+
+        # Side 1: every record_rewrite("lit") under locust_tpu/ is a
+        # registry entry.  The optimize module's own string constants
+        # OUTSIDE the registry assignment double as the applied-side
+        # evidence for side 2 (exact whole-string match — docstrings
+        # don't count, a rule id embedded in prose is not an
+        # application site).
+        applied_literals: set[str] = set()
+        for sf in files:
+            if sf.rel == self.optimize_rel:
+                for node in ast.walk(sf.tree):
+                    if (
+                        isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and not (span[0] <= node.lineno <= span[1])
+                    ):
+                        applied_literals.add(node.value)
+            if sf.rel.split("/", 1)[0] != "locust_tpu":
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if call_name(node).split(".")[-1] != "record_rewrite":
+                    continue
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    r = node.args[0].value
+                    if r not in rules:
+                        yield Finding(
+                            self.rule_id, sf.rel, node.lineno,
+                            node.col_offset,
+                            f"rewrite rule {r!r} is not in REWRITE_RULES "
+                            f"({self.optimize_rel}) — an unregistered id "
+                            "fails loudly at the firing site; register it",
+                        )
+
+        def read(rel):
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                return None
+
+        docs_text = read(self.docs_rel)
+        # Same exclusion as R014: the analyzer's own suite quotes
+        # phantom rule ids to test the RULE — those are not coverage.
+        tests_text = "\n".join(
+            sf.text for sf in files
+            if sf.rel.split("/", 1)[0] == "tests"
+            and sf.rel != self.analyzer_tests_rel
+        )
+        if docs_text is None:
+            yield Finding(
+                self.rule_id, self.docs_rel, 1, 0,
+                f"plan docs {self.docs_rel} missing — REWRITE_RULES "
+                "entries cannot be verified as documented",
+            )
+
+        # Side 2: every registered rule is applied, exercised, documented.
+        for rule, line in sorted(rules.items()):
+            if rule not in applied_literals:
+                yield Finding(
+                    self.rule_id, self.optimize_rel, line, 0,
+                    f"REWRITE_RULES entry {rule!r} is never applied in "
+                    f"{self.optimize_rel} — a registered rewrite nothing "
+                    "fires is a dead contract",
+                )
+            if f'"{rule}"' not in tests_text:
+                yield Finding(
+                    self.rule_id, self.optimize_rel, line, 0,
+                    f"REWRITE_RULES entry {rule!r} is never exercised "
+                    "under tests/ — an untested rewrite is an untested "
+                    "byte-identity claim",
+                )
+            if docs_text is not None and f"`{rule}`" not in docs_text:
+                yield Finding(
+                    self.rule_id, self.optimize_rel, line, 0,
+                    f"REWRITE_RULES entry {rule!r} is undocumented in "
+                    f"{self.docs_rel} (backtick the rule in the "
+                    "Optimizer section)",
                 )

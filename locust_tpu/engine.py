@@ -85,6 +85,38 @@ def finalize_host_pairs(
     return sorted(pairs) if sort else pairs
 
 
+def _wrap_i32(v: int) -> int:
+    """Two's-complement int32 wraparound — the device table's value
+    dtype, so a host-side merge wraps exactly where a full device fold
+    would."""
+    v &= 0xFFFFFFFF
+    return v - 0x100000000 if v >= 0x80000000 else v
+
+
+def merge_host_pairs(
+    base: list[tuple[bytes, int]],
+    delta: list[tuple[bytes, int]],
+    combine: str = "sum",
+) -> list[tuple[bytes, int]]:
+    """Merge two finalized host-pairs lists by key — the mergeable-table
+    property the plan optimizer's incremental refold rides
+    (plan/optimize.py ``incremental_fold``): an exact fold is a pure
+    function of the line multiset, so fold(prefix) ⊕ fold(delta) ==
+    fold(prefix + delta).  Sum/count merge with int32 WRAPAROUND to
+    match the device accumulator's dtype bit-for-bit; ordering matches
+    ``finalize_host_pairs`` (lexicographic key sort)."""
+    op = _HOST_COMBINE[combine]
+    wrap = combine in ("sum", "count")
+    merged: dict[bytes, int] = dict(base)
+    for k, v in delta:
+        if k in merged:
+            out = op(merged[k], v)
+            merged[k] = _wrap_i32(int(out)) if wrap else out
+        else:
+            merged[k] = v
+    return sorted(merged.items())
+
+
 @dataclasses.dataclass
 class StageTimes:
     """Per-stage wall-clock, the reference's timing report (main.cu:405-468)."""

@@ -38,6 +38,7 @@ NAMES = {
     "serve.place": "span",          # serve: pool placement decision (pool.py)
     "serve.demux": "span",          # serve: per-job result split + store
     "serve.ship": "span",           # serve: one WAL ship/catch-up RPC (replicate.py)
+    "plan.optimize": "span",        # plan: the rewrite pass (optimize.py)
     "plan.compile": "span",         # plan: DAG lowering onto the engine
     "plan.run": "span",             # plan: one compiled-plan execution
     "plan.stage": "span",           # plan: one distributed stage RPC (both sides)
@@ -78,6 +79,9 @@ NAMES = {
     "plan.partition_bytes": "counter",  # published shuffle-partition bytes
     "plan.recomputes": "counter",   # plan stages recomputed after a failure
     "plan.speculated": "counter",   # speculative backup stage attempts
+    "plan.rewrites": "counter",     # optimizer rewrites applied (optimize.py)
+    "plan.subcache_hits": "counter",    # sub-plan result cache hits
+    "plan.subcache_misses": "counter",  # ... and fold recomputes paid
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")

@@ -27,6 +27,11 @@ from locust_tpu.plan.nodes import (  # noqa: F401
     from_json,
     node,
 )
+from locust_tpu.plan.optimize import (  # noqa: F401
+    REWRITE_RULES,
+    Optimized,
+    optimize,
+)
 
 _LAZY = ("compile_plan", "CompiledPlan", "PlanResult")
 

@@ -38,6 +38,11 @@ import dataclasses
 
 from locust_tpu import obs
 from locust_tpu.plan.nodes import Node, Plan, PlanError
+from locust_tpu.plan.optimize import (
+    incremental_delta,
+    optimize as optimize_plan,
+    record_rewrite,
+)
 
 # Serve-side bound on the pagerank state size: ``num_nodes`` derives
 # from the max node id in the CORPUS, so a 12-byte submit naming node
@@ -82,14 +87,26 @@ class CompiledPlan:
     warm exactly like a resident ``MapReduceEngine`` does.
     """
 
-    def __init__(self, plan: Plan, cfg=None, mesh: bool = False):
-        self.plan = plan
+    def __init__(self, plan: Plan, cfg=None, mesh: bool = False,
+                 optimize: bool = True):
+        self.plan = plan  # the ORIGINAL plan: cache/WAL identity
         self.cfg = cfg
         self.mesh = mesh
         self._engine = None  # lazy MapReduceEngine (wordcount fold)
+        # The rewrite pass (plan/optimize.py) runs between validation
+        # and lowering; ``self.plan`` stays the original so every
+        # fingerprint-keyed identity (warm/result caches, WAL replay,
+        # batch keys) is untouched, and the LOWERED plan is the
+        # optimizer's output — byte-identical by the rule contracts.
+        self.optimized = (
+            optimize_plan(plan, cfg=cfg, mesh=mesh) if optimize else None
+        )
+        self._lowered = (
+            self.optimized.plan if self.optimized is not None else plan
+        )
         with obs.span("plan.compile", plan=plan.fingerprint()):
-            self._by_id = plan.by_id()
-            self._sink = plan.sink()
+            self._by_id = self._lowered.by_id()
+            self._sink = self._lowered.sink()
             self._stages: dict[str, tuple] = {}
             self._root = self._lower(self._sink.id)
         if cfg is None and any(
@@ -148,7 +165,11 @@ class CompiledPlan:
                 raise PlanError(
                     f"node {n.id!r}: tfidf_score must consume the tf fold"
                 )
-            stage = ("score", tf_id)
+            composed = (
+                self.optimized is not None
+                and n.id in self.optimized.composed_scores
+            )
+            stage = ("score", tf_id, composed)
         elif n.kind == "map":
             # tokenize maps only exist fused under a shuffle+reduce; a
             # bare token stream has no materialization (the fixed-slot
@@ -196,6 +217,9 @@ class CompiledPlan:
         finalize: bool = True,
         checkpoint_dir: str | None = None,
         every: int = 8,
+        sub_cache=None,
+        corpus_sha: str | None = None,
+        corpus_bytes: bytes | None = None,
     ) -> PlanResult:
         """Execute the compiled plan.
 
@@ -230,7 +254,9 @@ class CompiledPlan:
         with obs.span("plan.run", plan=self.plan.fingerprint()):
             ctx = _RunCtx(self, data, num_nodes, timed,
                           checkpoint_dir, every, finalize=finalize,
-                          max_nodes=max_nodes)
+                          max_nodes=max_nodes, sub_cache=sub_cache,
+                          corpus_sha=corpus_sha,
+                          corpus_bytes=corpus_bytes)
             value = ctx.eval(self._stages[self._root][2])
             render_op = self._stages[self._root][1]
             output = _render(render_op, value) if render else None
@@ -258,7 +284,8 @@ class CompiledPlan:
             )
         return self._wordcount_engine().run_stream(blocks, **kw)
 
-    def run_corpus(self, corpus: bytes) -> PlanResult:
+    def run_corpus(self, corpus: bytes, *, sub_cache=None,
+                   corpus_sha: str | None = None) -> PlanResult:
         """The serve tier's entry: raw corpus bytes in, rendered result
         out.  Text sources split lines exactly like the daemon's batch
         stager (``serve/batch.split_lines``); edge sources parse the
@@ -284,20 +311,37 @@ class CompiledPlan:
             return self.run(
                 (src, dst), max_nodes=SERVE_MAX_PAGERANK_NODES
             )
-        return self.run(corpus.splitlines())
+        if sub_cache is not None and corpus_sha is None:
+            import hashlib
+
+            corpus_sha = hashlib.sha256(corpus).hexdigest()
+        return self.run(corpus.splitlines(), sub_cache=sub_cache,
+                        corpus_sha=corpus_sha, corpus_bytes=corpus)
 
     def _wordcount_engine(self):
         if self._engine is None:
             from locust_tpu.engine import MapReduceEngine
 
-            self._engine = MapReduceEngine(self.cfg)
+            cfg = self.cfg
+            if self.optimized is not None and self.optimized.fuse_kernel:
+                # fuse_fold_kernel (plan/optimize.py): the wordcount
+                # fold engages the Pallas megakernel — the engine's own
+                # eligibility check stays the runtime authority and
+                # degrades to plain hasht byte-identically off
+                # supported shapes/backends.
+                cfg = dataclasses.replace(cfg, sort_mode="fused")
+            self._engine = MapReduceEngine(cfg)
         return self._engine
 
 
-def compile_plan(plan: Plan, cfg=None, mesh: bool = False) -> CompiledPlan:
+def compile_plan(plan: Plan, cfg=None, mesh: bool = False,
+                 optimize: bool = True) -> CompiledPlan:
     """Lower ``plan`` onto the engine tier; raises ``PlanError`` on any
-    composition outside the supported signatures (docs/PLAN.md)."""
-    return CompiledPlan(plan, cfg=cfg, mesh=mesh)
+    composition outside the supported signatures (docs/PLAN.md).
+    ``optimize=False`` skips the rewrite pass (plan/optimize.py) — the
+    naive 1:1 lowering the optimizer's byte-identity contract is pinned
+    against."""
+    return CompiledPlan(plan, cfg=cfg, mesh=mesh, optimize=optimize)
 
 
 class _RunCtx:
@@ -305,7 +349,9 @@ class _RunCtx:
 
     def __init__(self, cp: CompiledPlan, data, num_nodes, timed,
                  checkpoint_dir, every, finalize: bool = True,
-                 max_nodes: int | None = None):
+                 max_nodes: int | None = None, sub_cache=None,
+                 corpus_sha: str | None = None,
+                 corpus_bytes: bytes | None = None):
         self.cp = cp
         self.data = data
         self.num_nodes = num_nodes
@@ -315,8 +361,26 @@ class _RunCtx:
         self.every = every
         self.finalize = finalize
         self.run_result = None
+        self.sub_cache = sub_cache        # serve.cache.SubPlanCache
+        self.corpus_sha = corpus_sha
+        self.corpus_bytes = corpus_bytes
         self._memo: dict[str, object] = {}
         self._acct: dict[str, tuple] = {}  # stage id -> (dist, trunc, ovf)
+
+    def _sub_engaged(self) -> bool:
+        """Per-edge sub-result caching engages only on the serve path
+        (run_corpus with a cache): plain host pairs in/out, no engine
+        side effects — timed/checkpointed/unfinalized runs and mesh
+        execution need the engine's own artifacts, so they stay naive."""
+        return (
+            self.sub_cache is not None
+            and self.corpus_sha is not None
+            and self.corpus_bytes is not None
+            and self.finalize
+            and not self.cp.mesh
+            and not self.timed
+            and not self.checkpoint_dir
+        )
 
     # -------------------------------------------------------------- eval
 
@@ -374,6 +438,151 @@ class _RunCtx:
         return rows, ids
 
     def _eval_fold(self, sid: str, stage):
+        """Fold-stage dispatch: sub-plan cache consult (exact hit ->
+        skip even the source staging; verified append-only regrowth ->
+        delta-only refold + merge) before the full fold.  Every path
+        returns EXACTLY what the naive fold returns — cached values are
+        the bytes a previous identical fold produced, and the
+        incremental merge rides the mergeable-table property with
+        bail-to-full guards wherever a full refold could differ
+        (truncation, capacity) — docs/PLAN.md "Optimizer"."""
+        if not self._sub_engaged():
+            return self._eval_fold_full(sid, stage)
+        sub = self.sub_cache
+        key_fp = self.cp._lowered.node_fingerprint(sid)
+        cfg_fp = self.cp.cfg.fingerprint()
+        ent = sub.get(key_fp, cfg_fp, self.corpus_sha)
+        if ent is not None:
+            obs.metric_inc("plan.subcache_hits")
+            return self._restore_fold_entry(sid, stage, ent)
+        obs.metric_inc("plan.subcache_misses")
+        ent = self._incremental_fold(sid, stage, sub, key_fp, cfg_fp)
+        if ent is not None:
+            return self._restore_fold_entry(sid, stage, ent)
+        value = self._eval_fold_full(sid, stage)
+        sub.put(key_fp, cfg_fp, self.corpus_sha,
+                self._fold_entry(sid, stage, value))
+        return value
+
+    def _restore_fold_entry(self, sid: str, stage, ent: dict):
+        fold = stage[1]
+        self._acct[sid] = (
+            int(ent["distinct"]), bool(ent["truncated"]),
+            int(ent["overflow"]),
+        )
+        if fold == "tf":
+            src_node = self.cp._stages[stage[2]][1]
+            k = src_node.param("lines_per_doc", 1)
+            n_lines = int(ent["n_lines"])
+            # n_docs exactly as the full path derives it: distinct of
+            # arange(n_lines) // k, i.e. ceil(n_lines / k), floor 1.
+            self._memo[f"{sid}.n_docs"] = (
+                -(-n_lines // k) if n_lines else 1
+            )
+        value = ent["value"]
+        # Shallow copies out of the cache: entry values are shared
+        # across runs and must never be mutated by a consumer.
+        return list(value) if isinstance(value, list) else dict(value)
+
+    def _fold_entry(self, sid: str, stage, value) -> dict:
+        fold = stage[1]
+        rows, _ids = self.eval(stage[2])
+        dist, trunc, ovf = self._acct[sid]
+        return {
+            "fold": fold, "value": value,
+            "distinct": int(dist), "truncated": bool(trunc),
+            "overflow": int(ovf),
+            "corpus_len": len(self.corpus_bytes),
+            "corpus_sha": self.corpus_sha,
+            "n_lines": int(rows.shape[0]),
+            "bytes": _fold_value_bytes(fold, value),
+        }
+
+    def _incremental_fold(self, sid: str, stage, sub, key_fp, cfg_fp):
+        """incremental_fold (plan/optimize.py): look for a cached entry
+        over a hash-verified append-only PREFIX of this corpus, refold
+        only the delta lines, merge.  Returns the merged entry (also
+        stored under the new corpus sha, so future growth chains), or
+        None -> full recompute."""
+        fold = stage[1]
+        if fold not in ("wordcount", "tf"):
+            return None  # index postings: exact-hit reuse only
+        for cand in sub.prefix_candidates(key_fp, cfg_fp):
+            info = incremental_delta(cand, self.corpus_bytes)
+            if info is None:
+                continue
+            merged = self._merge_delta(sid, stage, fold, cand, info)
+            if merged is None:
+                continue  # guard bailed: the full path owns this run
+            sub.put(key_fp, cfg_fp, self.corpus_sha, merged)
+            record_rewrite(info["rule"])
+            return merged
+        return None
+
+    def _merge_delta(self, sid: str, stage, fold: str, ent: dict,
+                     info: dict):
+        cfg = self.cp.cfg
+        rows, ids = self.eval(stage[2])
+        n_old = int(info["old_n_lines"])
+        n_total = int(rows.shape[0])
+        if not 0 <= n_old < n_total:
+            return None
+        delta_rows = rows[n_old:]
+        if fold == "wordcount":
+            from locust_tpu.engine import merge_host_pairs
+
+            eng = self.cp._wordcount_engine()
+            res = eng.run(delta_rows)
+            if res.truncated:
+                return None
+            pairs = merge_host_pairs(
+                ent["value"], res.to_host_pairs(), combine=eng.combine
+            )
+            if len(pairs) > cfg.resolved_table_size:
+                # A full refold would truncate, and only IT knows which
+                # keys survive — bail to the naive path.
+                return None
+            dist = len(pairs)
+            ovf = int(ent["overflow"]) + int(res.overflow_tokens)
+            value = pairs
+        else:  # tf
+            from locust_tpu.apps.inverted_index import (
+                default_pairs_capacity,
+            )
+            from locust_tpu.apps.tfidf import term_doc_counts
+            from locust_tpu.engine import _wrap_i32
+
+            try:
+                tf_delta = term_doc_counts(delta_rows, ids[n_old:], cfg)
+            except Exception:  # noqa: BLE001
+                # The delta fold hit a loss condition (overflow /
+                # capacity — term_doc_counts raises rather than
+                # truncate).  Bail so the NAIVE path recomputes and
+                # raises the canonical error for the full corpus.
+                return None
+            value = dict(ent["value"])
+            for key, v in tf_delta.items():
+                value[key] = _wrap_i32(int(value.get(key, 0)) + int(v))
+            if len(value) > default_pairs_capacity(cfg):
+                return None  # a full refold RAISES; let it
+            dist, ovf = len(value), 0
+        bl = cfg.block_lines
+        sub = self.sub_cache
+        sub.record_incremental(
+            delta_blocks=-(-(n_total - n_old) // bl),
+            total_blocks=max(1, -(-n_total // bl)),
+        )
+        return {
+            "fold": fold, "value": value,
+            "distinct": int(dist), "truncated": False,
+            "overflow": int(ovf),
+            "corpus_len": len(self.corpus_bytes),
+            "corpus_sha": self.corpus_sha,
+            "n_lines": n_total,
+            "bytes": _fold_value_bytes(fold, value),
+        }
+
+    def _eval_fold_full(self, sid: str, stage):
         fold = stage[1]
         src_node = self.cp._stages[stage[2]][1]
         rows, ids = self.eval(stage[2])
@@ -441,8 +650,17 @@ class _RunCtx:
     def _eval_score(self, stage):
         from locust_tpu.apps.tfidf import scores_from_tf
 
-        tf = self.eval(stage[1])
-        return scores_from_tf(tf, self._memo[f"{stage[1]}.n_docs"])
+        tf_id = stage[1]
+        composed = len(stage) > 2 and stage[2]
+        if composed and tf_id not in self._memo:
+            # compose_score (plan/optimize.py): fold + rescore as ONE
+            # stage — the tf table is consumed inline and never
+            # retained in the stage memo (the reduce has exactly one
+            # consumer, so nothing else can ask for it).
+            tf = self._eval_fold(tf_id, self.cp._stages[tf_id])
+        else:
+            tf = self.eval(tf_id)
+        return scores_from_tf(tf, self._memo[f"{tf_id}.n_docs"])
 
     def _eval_join(self, sid: str, stage):
         _, left_id, right_id, combine = stage
@@ -503,6 +721,17 @@ class _RunCtx:
             return len(value), False, 0
         except TypeError:
             return 0, False, 0
+
+
+def _fold_value_bytes(fold: str, value) -> int:
+    """Byte-size estimate of one cached fold value (the sub-plan
+    cache's LRU accounting — the ``pairs_bytes`` stance: an estimate
+    that tracks growth, not an exact RSS)."""
+    if fold == "wordcount":
+        return sum(len(k) + 8 for k, _v in value)
+    if fold == "tf":
+        return sum(len(w) + 16 for (w, _d) in value)
+    return sum(len(w) + 8 * len(docs) for w, docs in value.items())
 
 
 def rank_row(node: int, rank: float) -> bytes:

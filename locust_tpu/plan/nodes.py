@@ -244,6 +244,34 @@ class Plan:
             object.__setattr__(self, "_fingerprint", fp)
         return fp
 
+    def node_fingerprint(self, nid: str) -> str:
+        """Content-addressed fingerprint of ``nid``'s upstream CLOSURE —
+        a Merkle hash over (kind, op, params, input closure fps), so it
+        is independent of node IDS and of unrelated siblings: two
+        tenants' plans that spell the same tokenize→fold prefix under
+        different names share the fingerprint (alpha-equivalence).  The
+        optimizer's CSE rewrite and the serve tier's sub-plan result
+        cache key on exactly this identity (docs/PLAN.md "Optimizer").
+        Memoized like ``fingerprint()`` — one topo sweep per plan."""
+        fps = self.__dict__.get("_node_fps")
+        if fps is None:
+            fps = {}
+            by_id = self.by_id()
+            for oid in self.topo_order():
+                n = by_id[oid]
+                payload = json.dumps(
+                    [n.kind, n.op, list(n.params),
+                     [fps[ref] for ref in n.inputs]],
+                    sort_keys=True, separators=(",", ":"),
+                )
+                fps[oid] = hashlib.sha1(
+                    payload.encode()
+                ).hexdigest()[:12]
+            object.__setattr__(self, "_node_fps", fps)
+        if nid not in fps:
+            raise PlanError(f"no node {nid!r} in this plan")
+        return fps[nid]
+
     # ---------------------------------------------------------- structure
 
     def by_id(self) -> dict:

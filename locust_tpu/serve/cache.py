@@ -320,6 +320,132 @@ class ResultCache:
         return n
 
 
+class SubPlanCache:
+    """Per-EDGE fold results keyed by (closure fingerprint, config
+    fingerprint, corpus sha256) — the plan optimizer's cross-tenant
+    sub-plan cache (docs/PLAN.md "Optimizer").
+
+    Generalizes ``ResultCache``'s byte-identity discipline from whole-
+    job to per-edge: the closure fingerprint
+    (``Plan.node_fingerprint``) is node-id independent, so two tenants
+    whose plans spell the same corpus + tokenize prefix under different
+    names share the entry.  Same bounding stance as ``ResultCache``
+    (byte-capped LRU, count cap, one oversized entry still serves),
+    same explicit invalidation.  IN-MEMORY ONLY by design: WAL replay
+    after a restart recomputes from a cold cache and must reproduce the
+    same bytes — the optimizer's identity contract, pinned by tests.
+
+    Entries are dicts built by ``plan/compile._RunCtx`` (value + loss
+    accounting + ``corpus_len``/``corpus_sha``/``n_lines`` + a
+    ``bytes`` size estimate).  ``prefix_candidates`` feeds the
+    incremental-refold probe: entries under the same (closure, config)
+    identity, newest-corpus first, whose corpus may be a verified
+    prefix of a grown resubmit (``optimize.incremental_delta`` does the
+    hash verification — nothing here trusts a client).
+    """
+
+    def __init__(self, max_entries: int = 256,
+                 max_bytes: int = 128 << 20):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        # key: (closure_fp, cfg_fp, corpus_sha) -> entry dict (LRU order)
+        self._entries: dict[tuple[str, str, str], dict] = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.incremental_hits = 0
+        self.invalidations = 0
+        # Last incremental merge's block accounting (bench/check
+        # evidence: the delta refold must touch FEWER blocks than a
+        # full one).
+        self.last_delta_blocks = 0
+        self.last_total_blocks = 0
+
+    def get(self, closure_fp: str, cfg_fp: str,
+            corpus_sha: str) -> dict | None:
+        with self._lock:
+            ent = self._entries.pop((closure_fp, cfg_fp, corpus_sha),
+                                    None)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries[(closure_fp, cfg_fp, corpus_sha)] = ent
+            self.hits += 1
+            return ent
+
+    def put(self, closure_fp: str, cfg_fp: str, corpus_sha: str,
+            entry: dict) -> None:
+        size = int(entry.get("bytes") or 0)
+        key = (closure_fp, cfg_fp, corpus_sha)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= int(old.get("bytes") or 0)
+            self._entries[key] = entry
+            self._bytes += size
+            while (len(self._entries) > self.max_entries
+                   or self._bytes > self.max_bytes):
+                if len(self._entries) == 1:
+                    break  # one oversized entry still serves hits
+                ent = self._entries.pop(next(iter(self._entries)))
+                self._bytes -= int(ent.get("bytes") or 0)
+
+    def prefix_candidates(self, closure_fp: str,
+                          cfg_fp: str) -> list[dict]:
+        """Entries under (closure_fp, cfg_fp) regardless of corpus —
+        largest corpus first, so the incremental probe tries the
+        longest verified prefix (smallest delta) before older
+        generations."""
+        with self._lock:
+            ents = [
+                ent for (fp, cf, _sha), ent in self._entries.items()
+                if fp == closure_fp and cf == cfg_fp
+            ]
+        return sorted(
+            ents, key=lambda e: int(e.get("corpus_len") or 0),
+            reverse=True,
+        )
+
+    def record_incremental(self, delta_blocks: int,
+                           total_blocks: int) -> None:
+        with self._lock:
+            self.incremental_hits += 1
+            self.last_delta_blocks = int(delta_blocks)
+            self.last_total_blocks = int(total_blocks)
+
+    def invalidate(self, corpus_sha: str | None = None) -> int:
+        """Drop entries for one corpus (None = everything); returns the
+        count.  Rides the daemon's existing invalidation surface: an
+        ``--invalidate`` submit or an explicit invalidate for a corpus
+        digest drops the per-edge entries too — a tenant asking for a
+        fresh recompute must not be answered from a sub-plan edge."""
+        with self._lock:
+            doomed = [
+                k for k in self._entries
+                if corpus_sha is None or k[2] == corpus_sha
+            ]
+            for k in doomed:
+                self._bytes -= int(self._entries.pop(k).get("bytes") or 0)
+            self.invalidations += len(doomed)
+            return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "incremental_hits": self.incremental_hits,
+                "invalidations": self.invalidations,
+                "last_delta_blocks": self.last_delta_blocks,
+                "last_total_blocks": self.last_total_blocks,
+            }
+
+
 class WarmState:
     """Persist the result cache across daemon restarts, asynchronously.
 

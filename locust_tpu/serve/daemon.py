@@ -59,6 +59,7 @@ from locust_tpu.serve import batch as batching
 from locust_tpu.serve.cache import (
     ExecutableCache,
     ResultCache,
+    SubPlanCache,
     WarmState,
 )
 from locust_tpu.config import EngineConfig
@@ -107,6 +108,10 @@ class ServeConfig:
     max_engines: int = 4         # warm engines kept (LRU)
     max_results: int = 256       # result-cache entries kept (LRU)
     max_result_bytes: int = 256 << 20  # result-cache aggregate byte cap
+    # Sub-plan (per-edge) result cache byte cap — plan fold values
+    # shared across tenants by closure fingerprint (docs/PLAN.md
+    # "Optimizer"); entry count rides max_results.
+    max_subplan_bytes: int = 128 << 20
     # Aggregate cap on result payloads retained by FINISHED job records
     # (max_history bounds record COUNT; 1024 records of multi-MB pairs
     # would be GBs of RSS).  Past it the oldest finished records are
@@ -208,6 +213,13 @@ class ServeDaemon:
         self.results = ResultCache(
             max_entries=self.cfg.max_results,
             max_bytes=self.cfg.max_result_bytes,
+        )
+        # Per-edge fold results for plan jobs (the optimizer's CSE +
+        # incremental-refold substrate, docs/PLAN.md "Optimizer").
+        # In-memory only: WAL replay recomputes from cold, identically.
+        self.subplans = SubPlanCache(
+            max_entries=self.cfg.max_results,
+            max_bytes=self.cfg.max_subplan_bytes,
         )
         self.warm = (
             WarmState(self.cfg.warm_dir, self.results)
@@ -733,6 +745,9 @@ class ServeDaemon:
             # already skips lookups for invalidate submits, so this job
             # recomputes either way.)
             self.results.invalidate(digest=digest, spec_fp=spec_fp)
+            # A fresh-recompute request must not be answered from the
+            # per-edge cache either (same post-admission discipline).
+            self.subplans.invalidate(corpus_sha=digest)
         obs.event(
             "serve.admit",
             job=job.job_id, tenant=spec.tenant, bucket=bucket,
@@ -883,6 +898,13 @@ class ServeDaemon:
             digest=str(digest) if digest else None,
             spec_fp=str(spec_fp) if spec_fp else None,
         )
+        # Per-edge entries for the same corpus go too (a spec_fp-only
+        # invalidation keeps them: closure fingerprints are shared
+        # across specs, and other tenants' edges stay warm).
+        if digest or not spec_fp:
+            n += self.subplans.invalidate(
+                corpus_sha=str(digest) if digest else None
+            )
         return {"status": "ok", "invalidated": n}
 
     def _cmd_stats(self) -> dict:
@@ -912,6 +934,7 @@ class ServeDaemon:
             ),
             "exec_cache": self.executables.stats(),
             "result_cache": self.results.stats(),
+            "subplan_cache": self.subplans.stats(),
             "warm": self.warm.stats() if self.warm is not None else None,
             "journal": (
                 self.journal.stats() if self.journal is not None else None
@@ -1456,7 +1479,9 @@ class ServeDaemon:
                     "serve.dispatch", jobs=1, bucket=job.bucket,
                 ):
                     pres = executor.run_corpus(
-                        corpora[job.corpus_digest]
+                        corpora[job.corpus_digest],
+                        sub_cache=self.subplans,
+                        corpus_sha=job.corpus_digest,
                     )
                 self.executables.mark_compiled(spec, 1, job.bucket)
                 with obs.span("serve.demux", jobs=1):

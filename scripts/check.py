@@ -3,7 +3,7 @@
 
     env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
 
-Runs (1) the two-phase invariant checker (R001-R014) over the configured
+Runs (1) the two-phase invariant checker (R001-R015) over the configured
 paths (exit 1 on new findings — docs/ANALYSIS.md) including a SARIF
 emission round-trip, (2) tests/test_analysis.py, which includes the
 repo-wide gate test, and (3) a small traced engine run whose exported
@@ -453,6 +453,65 @@ try:
     assert warm.returncode == 0, warm.stderr[-800:]
     assert warm.stdout == one_shot.stdout
     assert b"(cached)" in warm.stderr, warm.stderr[-400:]
+
+    # Cross-tenant sub-plan sharing (docs/PLAN.md "Optimizer"): an
+    # alpha-RENAMED tfidf plan — different plan fingerprint, so the
+    # whole-job result cache MISSES — over the same corpus lands on the
+    # per-edge entry the first tenant populated.
+    doc = tfidf_plan(2).to_doc()
+    for n in doc["nodes"]:
+        n["id"] = "x_" + n["id"]
+        n["inputs"] = ["x_" + r for r in n["inputs"]]
+    plan2_path = os.path.join(td, "tfidf_plan_renamed.json")
+    with open(plan2_path, "w") as f:
+        json.dump(doc, f)
+    ten2 = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "submit", corpus_path,
+         "--plan", plan2_path, "--tenant", "t2", "--port", port]
+        + cfg_flags,
+        env=env, capture_output=True, timeout=240,
+    )
+    assert ten2.returncode == 0, ten2.stderr[-800:]
+    assert ten2.stdout == one_shot.stdout, (
+        "alpha-renamed plan != one-shot tfidf CLI"
+    )
+    assert b"(cached)" not in ten2.stderr  # not a whole-job cache hit
+
+    # Incremental resubmit: the corpus grows APPEND-ONLY; the daemon
+    # verifies the prefix sha server-side, re-folds only the delta
+    # blocks, and the result must still be byte-identical to a cold
+    # one-shot CLI over the grown corpus.
+    with open(corpus_path, "rb") as f:
+        base = f.read()
+    grown_path = os.path.join(td, "corpus_grown.txt")
+    with open(grown_path, "wb") as f:
+        f.write(base + b"eta theta\\nalpha eta\\n" * 8)
+    cold_grown = subprocess.run(
+        [sys.executable, "-m", "locust_tpu", "tfidf", grown_path,
+         "--backend", "cpu", "--lines-per-doc", "2"] + cfg_flags,
+        env=env, capture_output=True, timeout=240,
+    )
+    assert cold_grown.returncode == 0, cold_grown.stderr[-800:]
+    inc = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "submit", grown_path,
+         "--plan", plan_path, "--port", port] + cfg_flags,
+        env=env, capture_output=True, timeout=240,
+    )
+    assert inc.returncode == 0, inc.stderr[-800:]
+    assert inc.stdout == cold_grown.stdout, (
+        "incremental resubmit != cold one-shot CLI over the grown corpus"
+    )
+    stats = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "stats",
+         "--port", port],
+        env=env, capture_output=True, timeout=60,
+    )
+    assert stats.returncode == 0, stats.stderr[-800:]
+    sub = json.loads(stats.stdout)["subplan_cache"]
+    assert sub["hits"] >= 1, sub              # renamed tenant hit the edge
+    assert sub["incremental_hits"] >= 1, sub  # the delta refold engaged
+    assert 0 < sub["last_delta_blocks"] < sub["last_total_blocks"], sub
+
     subprocess.run(
         [sys.executable, "-m", "locust_tpu.serve", "shutdown",
          "--port", port],
@@ -463,7 +522,9 @@ finally:
     if daemon.poll() is None:
         daemon.kill()
 print("[check] plan smoke ok (two-stage tfidf plan byte-identical to "
-      "the one-shot CLI, repeat = plan-keyed result-cache hit)",
+      "the one-shot CLI, repeat = plan-keyed result-cache hit; "
+      "alpha-renamed second tenant = sub-plan edge hit; append-only "
+      "regrowth = incremental delta refold, still byte-identical)",
       file=sys.stderr)
 """
 

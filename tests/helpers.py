@@ -35,7 +35,22 @@ def serve_abandon(daemon):
     WITHOUT the graceful close() path (no drain, no warm flush, no
     journal compaction) — the crash the write-ahead journal exists for.
     One definition so the durability tests and rehearsals all model the
-    same crash."""
+    same crash.
+
+    The _closed latch must flip BEFORE the socket dies: the accept
+    loop's ``finally: close()`` otherwise races the "restarted" daemon
+    — the zombie drains the paused jobs as failed and compacts the very
+    journal the successor is replaying, two os.replace rewrites cross,
+    and the successor's terminal records land on an unlinked inode (a
+    real SIGKILL'd process can't run any of that)."""
     daemon._shutdown.set()
+    with daemon._lock:
+        daemon._closed = True
     daemon.scheduler.stop()
+    shipper = daemon.shipper
+    if shipper is not None:
+        # A dead process ships nothing: drop the replication stream so
+        # the standby sees silence (lease expiry) instead of a zombie
+        # that keeps heartbeating past its own "death".
+        shipper.stop()
     daemon._sock.close()

@@ -621,6 +621,7 @@ def test_r009_real_registry_mutation_fails_the_gate(tmp_path):
         "locust_tpu/serve/replicate.py",  # emits serve.ship/ship_lag
         "locust_tpu/backend.py",        # emits the backend.breaker_* ladder
         "locust_tpu/plan/compile.py",   # emits plan.compile/plan.run
+        "locust_tpu/plan/optimize.py",  # emits plan.optimize/plan.rewrites
         "locust_tpu/plan/distribute.py",  # emits plan.partition_bytes
         "locust_tpu/ops/pallas/fused_fold.py",  # kernel: must stay name-free
     ):
@@ -1434,13 +1435,160 @@ def test_r014_mutating_real_node_kinds_fails_the_gate(tmp_path):
     assert all("window" in f.message for f in res.new)
 
 
+# ------------------------------------------------------------------- R015
+
+_FIXTURE_OPTIMIZE = """
+    REWRITE_RULES = (
+        "fuse_two",
+        "drop_noop",
+    )
+
+    def record_rewrite(rule):
+        if rule not in REWRITE_RULES:
+            raise ValueError(rule)
+
+    def fuse(applied):
+        record_rewrite("fuse_two")
+        applied.append("fuse_two")
+
+    def drop(applied):
+        record_rewrite("drop_noop")
+        applied.append("drop_noop")
+"""
+
+
+def _r015_tree(tmp_path, optimize_src=None, docs_text=None,
+               tests_text=None):
+    _write(tmp_path, "locust_tpu/plan/optimize.py",
+           optimize_src if optimize_src is not None else _FIXTURE_OPTIMIZE)
+    _write(tmp_path, "tests/test_plan_optimize.py",
+           tests_text if tests_text is not None
+           else '# exercises "fuse_two" and "drop_noop"\n')
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "PLAN.md").write_text(
+        docs_text if docs_text is not None
+        else "| `fuse_two` | ... |\n| `drop_noop` | ... |\n"
+    )
+
+
+def test_r015_silent_when_registry_applied_docs_tests_agree(tmp_path):
+    _r015_tree(tmp_path)
+    assert not _run(tmp_path, ["R015"], ["locust_tpu", "tests"]).new
+
+
+def test_r015_fires_on_unregistered_rule_at_firing_site(tmp_path):
+    # A typo'd rule id passed to record_rewrite anywhere in locust_tpu/.
+    _r015_tree(tmp_path)
+    _write(tmp_path, "locust_tpu/plan/compile.py", """
+        from locust_tpu.plan.optimize import record_rewrite
+
+        def lower():
+            record_rewrite("fuse_twoo")
+    """)
+    res = _run(tmp_path, ["R015"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "fuse_twoo" in msgs and "not in REWRITE_RULES" in msgs
+
+
+def test_r015_fires_on_unapplied_untested_undocumented_rule(tmp_path):
+    _r015_tree(
+        tmp_path,
+        optimize_src=_FIXTURE_OPTIMIZE.replace(
+            '"fuse_two",', '"fuse_two",\n        "hoist_sink",'
+        ),
+    )
+    res = _run(tmp_path, ["R015"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert "never applied" in msgs
+    assert "never exercised" in msgs
+    assert "undocumented" in msgs
+    assert all("hoist_sink" in f.message for f in res.new)
+    assert len(res.new) == 3
+
+
+def test_r015_registry_literals_are_not_applied_evidence(tmp_path):
+    """The registry tuple's own literals must NOT count as application
+    sites — otherwise registering a rule would self-certify it as
+    applied and the 'dead contract' arm could never fire."""
+    _r015_tree(
+        tmp_path,
+        optimize_src="""
+        REWRITE_RULES = (
+            "fuse_two",
+        )
+    """,
+        docs_text="| `fuse_two` |\n",
+        tests_text='# quotes "fuse_two"\n',
+    )
+    res = _run(tmp_path, ["R015"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "never applied" in res.new[0].message
+
+
+def test_r015_analyzer_suite_quotes_do_not_count_as_coverage(tmp_path):
+    # Same exclusion as R014: phantom ids quoted in the analyzer's own
+    # fixtures are rule tests, not rewrite coverage.
+    _r015_tree(
+        tmp_path,
+        optimize_src=_FIXTURE_OPTIMIZE.replace(
+            '"fuse_two",', '"fuse_two",\n        "hoist_sink",'
+        ).replace(
+            'record_rewrite("fuse_two")',
+            'record_rewrite("fuse_two")\n        '
+            'record_rewrite("hoist_sink")',
+        ),
+        docs_text="| `fuse_two` | `drop_noop` | `hoist_sink` |\n",
+    )
+    _write(tmp_path, "tests/test_analysis.py",
+           '# quotes "hoist_sink" in a rule fixture, not a plan test\n')
+    res = _run(tmp_path, ["R015"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "never exercised" in res.new[0].message
+    assert "hoist_sink" in res.new[0].message
+
+
+def test_r015_missing_registry_reports_once(tmp_path):
+    _r015_tree(tmp_path, optimize_src="RULES = ()\n")
+    res = _run(tmp_path, ["R015"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "cannot parse the REWRITE_RULES registry" in res.new[0].message
+
+
+def test_r015_mutating_real_rewrite_rules_fails_the_gate(tmp_path):
+    """Acceptance demo on the REAL optimizer: copy the registry module +
+    suite + docs, register one phantom rule — the gate must fail with
+    exactly the unapplied/untested/undocumented findings for it."""
+    for rel in (
+        "locust_tpu/plan/optimize.py",
+        "locust_tpu/plan/nodes.py",
+        "tests/test_plan_optimize.py",
+        "docs/PLAN.md",
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    paths = ["locust_tpu", "tests"]
+    assert not _run(tmp_path, ["R015"], paths).new  # faithful copy: green
+
+    op = tmp_path / "locust_tpu/plan/optimize.py"
+    mutated = op.read_text().replace(
+        'REWRITE_RULES = (\n    "fuse_fold_kernel",',
+        'REWRITE_RULES = (\n    "hoist_sink",\n    "fuse_fold_kernel",', 1,
+    )
+    assert '"hoist_sink"' in mutated
+    op.write_text(mutated)
+    res = _run(tmp_path, ["R015"], paths)
+    assert len(res.new) == 3  # unapplied + untested + undocumented
+    assert all("hoist_sink" in f.message for f in res.new)
+
+
 # ------------------------------------------------------- registry + CLI
 
 
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
-        "R009", "R010", "R011", "R012", "R013", "R014",
+        "R009", "R010", "R011", "R012", "R013", "R014", "R015",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])
