@@ -85,8 +85,9 @@ def main(argv=None) -> int:
             return 2
 
     if args.sarif:
-        catalog = {rid: rcls.title for rid, rcls in all_rules().items()}
-        write_sarif(args.sarif, result, catalog)
+        # Rule CLASSES, not bare titles: the report derives helpUri and
+        # defaultConfiguration.level per rule from them.
+        write_sarif(args.sarif, result, dict(all_rules()))
         print(f"sarif: findings written to {args.sarif}", file=sys.stderr)
 
     if args.write_baseline:
@@ -96,7 +97,22 @@ def main(argv=None) -> int:
 
         path = args.baseline or os.path.join(root, conf["baseline"])
         # R000 never enters a baseline: fix the parse error / write the
-        # noqa reason instead of accepting it.
+        # noqa reason instead of accepting it.  Likewise findings marked
+        # non-baselineable by their rule (R016 phantom cmds: a cmd with
+        # no handler is never acceptable debt) — refuse the whole write
+        # loudly rather than silently burying a dead RPC.
+        refused = [
+            f for f in result.findings
+            if f.rule_id != "R000" and not f.baselineable
+        ]
+        if refused:
+            print(
+                "error: refusing to baseline non-baselineable "
+                "finding(s) — fix them instead:", file=sys.stderr,
+            )
+            for f in refused:
+                print(f"  {f.format()}", file=sys.stderr)
+            return 2
         n = write_baseline(
             path, [f for f in result.findings if f.rule_id != "R000"]
         )

@@ -24,6 +24,9 @@ DEFAULTS = {
         "__graft_entry__.py",
     ],
     "baseline": "analysis_baseline.json",
+    # Where scripts/check.py archives the SARIF log of its full pass
+    # (repo-relative; gitignored — an artifact, not a source of truth).
+    "sarif_artifact": "artifacts/analysis.sarif",
 }
 
 _SECTION = "tool.locust-analysis"
@@ -98,4 +101,6 @@ def load_config(root: str) -> dict:
         conf["paths"] = [str(p) for p in section["paths"]]
     if isinstance(section.get("baseline"), str):
         conf["baseline"] = section["baseline"]
+    if isinstance(section.get("sarif_artifact"), str):
+        conf["sarif_artifact"] = section["sarif_artifact"]
     return conf

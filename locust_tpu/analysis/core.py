@@ -24,6 +24,7 @@ import json
 import os
 import re
 import subprocess
+import time
 
 # R000 is the engine's own rule id: unparseable files and unauditable
 # (reason-less) suppressions.  It cannot be suppressed.
@@ -66,6 +67,10 @@ class Finding:
     severity: str = "error"
     baselined: bool = False
     fingerprint: str = ""
+    # False marks findings that are never acceptable debt (e.g. R016
+    # phantom cmds: a cmd with no handler) — ``--write-baseline`` refuses
+    # to record them instead of silently burying a dead RPC.
+    baselineable: bool = True
 
     def format(self) -> str:
         tag = " [baselined]" if self.baselined else ""
@@ -170,11 +175,15 @@ class AnalysisResult:
     suppressed: int
     n_files: int
     rules: list[str]
+    # Per-rule wall time (ms, 1 decimal) so a perf regression in the
+    # <10s self-perf pin is attributable to a rule, not just "the run".
+    rule_ms: dict[str, float] = dataclasses.field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return {
             "files": self.n_files,
             "rules": self.rules,
+            "rule_ms": self.rule_ms,
             "suppressed": self.suppressed,
             "total": len(self.findings),
             "new": len(self.new),
@@ -285,12 +294,17 @@ def run_analysis(
         from locust_tpu.analysis.summaries import build_program
 
         program = build_program(parsed, root)
+    rule_ms: dict[str, float] = {}
     for rule in rule_objs:
+        t0 = time.perf_counter()
         for sf in parsed:
             findings.extend(rule.check_file(sf, root))
         findings.extend(rule.check_project(parsed, root))
         if program is not None:
             findings.extend(rule.check_program(program))
+        rule_ms[rule.rule_id] = round(
+            (time.perf_counter() - t0) * 1000.0, 1
+        )
 
     # noqa suppression (reason mandatory; R000 is never suppressible).
     kept: list[Finding] = []
@@ -335,6 +349,7 @@ def run_analysis(
         suppressed=suppressed,
         n_files=len(files),
         rules=[r.rule_id for r in rule_objs],
+        rule_ms=rule_ms,
     )
 
 
@@ -416,6 +431,7 @@ def scope_to_changed(
         suppressed=result.suppressed,
         n_files=result.n_files,
         rules=result.rules,
+        rule_ms=result.rule_ms,
     )
 
 
@@ -426,7 +442,7 @@ def scope_to_changed(
 def unparse(node: ast.AST) -> str:
     try:
         return ast.unparse(node)
-    except Exception:  # pragma: no cover - unparse is total on parsed trees
+    except Exception:  # pragma: no cover  # locust: noqa[R017] unparse is total on parsed trees; "" is the documented fallback and there is no logger inside the engine to record to
         return ""
 
 

@@ -1,4 +1,4 @@
-"""The closed rule registry (R001–R015) — itself anti-drift-checked:
+"""The closed rule registry (R001–R018) — itself anti-drift-checked:
 ``get_rules`` rejects unknown ids loudly, and tests/test_analysis.py
 pins that every registered rule has firing + silent fixture coverage."""
 
@@ -16,6 +16,11 @@ from locust_tpu.analysis.rules_hygiene import (
 from locust_tpu.analysis.rules_plan import (
     PlanRegistryRule,
     RewriteRegistryRule,
+)
+from locust_tpu.analysis.rules_rpc import (
+    ChaosCoverageRule,
+    RpcSchemaRule,
+    SilentThreadDeathRule,
 )
 from locust_tpu.analysis.rules_serve import ServeErrorRegistryRule
 from locust_tpu.analysis.rules_telemetry import TelemetryRegistryRule
@@ -46,6 +51,9 @@ _RULE_CLASSES = (
     UnboundedBlockingRule,      # R013
     PlanRegistryRule,           # R014
     RewriteRegistryRule,        # R015
+    RpcSchemaRule,              # R016 (rpcflow: two-sided schema conformance)
+    SilentThreadDeathRule,      # R017 (thread death + silent swallows)
+    ChaosCoverageRule,          # R018 (chaos coverage per rpc cmd)
 )
 
 

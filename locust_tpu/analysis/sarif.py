@@ -20,8 +20,31 @@ SARIF_SCHEMA = (
 )
 
 
-def sarif_report(result, rule_catalog: dict[str, str]) -> dict:
-    """``AnalysisResult`` + {rule id: title} -> a SARIF log dict."""
+DEFAULT_HELP_URI = "docs/ANALYSIS.md#rule-catalog"
+
+
+def _rule_entry(rid: str, val) -> dict:
+    """One driver.rules entry.  ``val`` is either a bare title string
+    (the legacy catalog shape, kept working) or a Rule class — classes
+    contribute ``helpUri`` (the docs/ANALYSIS.md anchor, overridable via
+    a ``help_uri`` class attr) and ``defaultConfiguration.level`` derived
+    from the rule's ``severity``."""
+    if isinstance(val, str):
+        return {"id": rid, "shortDescription": {"text": val}}
+    sev = getattr(val, "severity", "error")
+    return {
+        "id": rid,
+        "shortDescription": {"text": val.title},
+        "helpUri": getattr(val, "help_uri", DEFAULT_HELP_URI),
+        "defaultConfiguration": {
+            "level": "error" if sev == "error" else "warning",
+        },
+    }
+
+
+def sarif_report(result, rule_catalog: dict) -> dict:
+    """``AnalysisResult`` + {rule id: title-or-Rule-class} -> a SARIF
+    log dict."""
     results = []
     for f in result.findings:
         results.append({
@@ -51,11 +74,8 @@ def sarif_report(result, rule_catalog: dict[str, str]) -> dict:
                     "name": "locust-analysis",
                     "informationUri": "docs/ANALYSIS.md",
                     "rules": [
-                        {
-                            "id": rid,
-                            "shortDescription": {"text": title},
-                        }
-                        for rid, title in sorted(rule_catalog.items())
+                        _rule_entry(rid, val)
+                        for rid, val in sorted(rule_catalog.items())
                     ],
                 },
             },
@@ -64,7 +84,7 @@ def sarif_report(result, rule_catalog: dict[str, str]) -> dict:
     }
 
 
-def write_sarif(path: str, result, rule_catalog: dict[str, str]) -> None:
+def write_sarif(path: str, result, rule_catalog: dict) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(sarif_report(result, rule_catalog), f, indent=2,
                   sort_keys=True)

@@ -17,7 +17,11 @@ function, the facts the interprocedural rules re-run over in phase 2
   * **donation facts** — names bound to ``jax.jit(..., donate_argnums=…)``
     and which return values alias host numpy memory (R010);
   * **lifecycle facts** — threads/executors spawned, daemonized, joined
-    or shut down (R012).
+    or shut down (R012);
+  * **module constants** — top-level string and tuple-of-string
+    assignments (``EPOCH_KEY = "_epoch"``, ``COMMANDS = (...)``) so the
+    message-flow pass (rpcflow.py, R016/R018) resolves wire-key
+    spellings and command registries without re-walking any tree.
 
 Summaries keep the parsed AST nodes (no re-parse, no source copies); the
 ``Program`` object owns the module table and the import-resolved call
@@ -176,33 +180,36 @@ def _declared_shared(fn: ast.AST) -> set[str]:
     """Names ``fn`` shares beyond its own frame: ``global`` anywhere in
     its subtree, ``nonlocal`` only when declared BY ``fn`` itself (a
     nested def's nonlocal refers to this function's own locals, which
-    are private to its thread)."""
+    are private to its thread).  One traversal, tracking nesting depth
+    (this runs per function; two subtree walks here dominated the
+    summaries build)."""
     names: set[str] = set()
-    for node in ast.walk(fn):
+    stack: list[tuple[ast.AST, bool]] = [(fn, False)]
+    first = True
+    while stack:
+        node, nested = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and not first:
+            nested = True
+        first = False
         if isinstance(node, ast.Global):
             names.update(node.names)
-
-    def own_statements(node):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                continue
-            yield child
-            yield from own_statements(child)
-
-    for node in own_statements(fn):
-        if isinstance(node, ast.Nonlocal):
+        elif isinstance(node, ast.Nonlocal) and not nested:
             names.update(node.names)
+        stack.extend((c, nested) for c in ast.iter_child_nodes(node))
     return names
 
 
 # --------------------------------------------------------- module summaries
 
 
-def _thread_entries(tree: ast.Module):
-    """(expr, how) for every function reference handed to a thread."""
-    executors = _executor_names(tree)
-    for node in ast.walk(tree):
+def _thread_entries(nodes: list):
+    """(expr, how) for every function reference handed to a thread.
+    ``nodes`` is the module's shared pre-walked node list — these
+    module-level scans used to each re-walk the tree, and the repeated
+    traversal (not the matching) was the summaries-build hot spot."""
+    executors = _executor_names(nodes)
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         callee = call_name(node)
@@ -223,9 +230,9 @@ def _thread_entries(tree: ast.Module):
                 yield node.args[0], "executor.map callable"
 
 
-def _executor_names(tree: ast.AST) -> set[str]:
+def _executor_names(nodes: list) -> set[str]:
     names: set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.withitem):
             ctx, opt = node.context_expr, node.optional_vars
             if (
@@ -242,12 +249,12 @@ def _executor_names(tree: ast.AST) -> set[str]:
     return names
 
 
-def _traced_fn_exprs(tree: ast.Module):
+def _traced_fn_exprs(nodes: list):
     """Expressions positioned as the to-be-traced function: first arg of
     tracer calls (unwrapping nested tracer calls), plus decorated defs
     (the whole decorator is matched, for the dominant
     ``@functools.partial(jax.jit, ...)`` idiom)."""
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Call) and _TRACER_RE.search(call_name(node)):
             if node.args:
                 arg = node.args[0]
@@ -279,13 +286,13 @@ def _donate_positions(expr: ast.AST) -> tuple[int, ...]:
     return tuple(sorted(pos))
 
 
-def _donating(tree: ast.Module) -> dict[str, tuple[int, ...]]:
+def _donating(nodes: list) -> dict[str, tuple[int, ...]]:
     """name/attr -> donated arg positions, for every binding of a
     ``jax.jit(fn, donate_argnums=...)`` result and every def decorated
     with a donating jit.  A kwarg spelled as a local Name is resolved
     through the module's simple ``name = expr`` assignments."""
     assigns: dict[str, list[ast.AST]] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name):
@@ -314,7 +321,7 @@ def _donating(tree: ast.Module) -> dict[str, tuple[int, ...]]:
         return ()
 
     out: dict[str, tuple[int, ...]] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             pos = positions_of(node.value)
             if pos:
@@ -332,14 +339,14 @@ def _donating(tree: ast.Module) -> dict[str, tuple[int, ...]]:
     return out
 
 
-def _spawns(tree: ast.Module):
+def _spawns(nodes: list):
     """Thread/executor lifecycle facts for R012."""
     bound: dict[int, str] = {}  # id(call node) -> dotted target text
     with_ctx: set[int] = set()
     joined: set[str] = set()
     shutdown: set[str] = set()
     daemon_after: set[str] = set()  # `t.daemon = True` after construction
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Assign):
             if isinstance(node.value, ast.Call):
                 for t in node.targets:
@@ -363,7 +370,7 @@ def _spawns(tree: ast.Module):
                 shutdown.add(unparse(node.func.value))
 
     spawns: list[SpawnFact] = []
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         callee = call_name(node)
@@ -389,7 +396,7 @@ def _spawns(tree: ast.Module):
             ))
     # Thread(...).start() with no binding: the call node is the .start
     # attribute's receiver.
-    for node in ast.walk(tree):
+    for node in nodes:
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
@@ -401,6 +408,49 @@ def _spawns(tree: ast.Module):
                 if (s.line, s.col) == (inner.lineno, inner.col_offset):
                     s.chained_start = True
     return spawns, joined, shutdown
+
+
+def _const_str_seq(v: ast.AST) -> tuple | None:
+    """A tuple/list/set of string constants (command registries are
+    spelled this way), following ``+`` concatenation of resolvable
+    halves."""
+    if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in v.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(v, ast.BinOp) and isinstance(v.op, ast.Add):
+        left = _const_str_seq(v.left)
+        right = _const_str_seq(v.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _module_consts(tree: ast.Module):
+    """Top-level ``NAME = "str"`` and ``NAME = ("a", "b", ...)`` tables —
+    the wire-key constants (protocol.EPOCH_KEY) and command registries
+    the rpcflow pass resolves spellings through (R016/R018)."""
+    strs: dict[str, str] = {}
+    seqs: dict[str, tuple] = {}
+    for stmt in tree.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            continue
+        name, v = stmt.targets[0].id, stmt.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            strs[name] = v.value
+        else:
+            items = _const_str_seq(v)
+            if items is not None:
+                seqs[name] = items
+    return strs, seqs
 
 
 class ModuleSummary:
@@ -416,10 +466,14 @@ class ModuleSummary:
         self.by_name: dict[str, list[FunctionSummary]] = {}
         self.top_by_name: dict[str, list[FunctionSummary]] = {}
         self._collect(tree, nested=False)
-        self.thread_entries = list(_thread_entries(tree))
-        self.traced_exprs = list(_traced_fn_exprs(tree))
-        self.donating = _donating(tree)
-        self.spawns, self.joined, self.shutdown = _spawns(tree)
+        # One walk, shared by every module-level scan below: re-walking
+        # the tree per scan (not the matching) was the build hot spot.
+        nodes = list(ast.walk(tree))
+        self.thread_entries = list(_thread_entries(nodes))
+        self.traced_exprs = list(_traced_fn_exprs(nodes))
+        self.donating = _donating(nodes)
+        self.spawns, self.joined, self.shutdown = _spawns(nodes)
+        self.str_consts, self.seq_consts = _module_consts(tree)
 
     def _collect(self, node: ast.AST, nested: bool) -> None:
         for child in ast.iter_child_nodes(node):

@@ -193,15 +193,18 @@ def _eager_init(timeout_s: float) -> str:
     """
     done = threading.Event()
 
-    def watch() -> None:
+    def watch() -> None:  # locust: noqa[R017] the exit is in a finally — the watchdog cannot die without firing; a broad except that _exit()s would turn a print failure into a spurious abort
         if not done.wait(timeout_s):
-            print(
-                f"locust_tpu: backend init exceeded {timeout_s:.0f}s "
-                "(wedged TPU tunnel?); aborting. Re-run with backend=cpu.",
-                file=sys.stderr,
-                flush=True,
-            )
-            os._exit(3)
+            try:
+                print(
+                    f"locust_tpu: backend init exceeded {timeout_s:.0f}s "
+                    "(wedged TPU tunnel?); aborting. "
+                    "Re-run with backend=cpu.",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            finally:
+                os._exit(3)
 
     threading.Thread(target=watch, daemon=True).start()
     try:

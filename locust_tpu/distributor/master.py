@@ -458,20 +458,31 @@ def _heartbeat_loop(
     """Ping quarantined workers whose backoff expired; un-quarantine on a
     good pong, deepen the backoff otherwise.  Runs until the job ends."""
     while not stop.wait(interval):
-        for idx in range(len(cluster)):
-            if stop.is_set():
-                return
-            if not health.probe_due(idx):
-                continue
-            try:
-                resp = rpc(cluster[idx], {"cmd": "ping"}, secret)
-                if resp.get("pong"):
-                    health.ok(idx)
-                    logger.info("worker %d recovered; un-quarantined", idx)
-                else:
+        try:
+            for idx in range(len(cluster)):
+                if stop.is_set():
+                    return
+                if not health.probe_due(idx):
+                    continue
+                try:
+                    resp = rpc(cluster[idx], {"cmd": "ping"}, secret)
+                    if resp.get("pong"):
+                        health.ok(idx)
+                        logger.info(
+                            "worker %d recovered; un-quarantined", idx
+                        )
+                    else:
+                        health.fail(idx)
+                except (OSError, MasterError, ValueError, PermissionError):
                     health.fail(idx)
-            except (OSError, MasterError, ValueError, PermissionError):
-                health.fail(idx)
+        except Exception:  # noqa: BLE001 - a surprise here (health
+            # bookkeeping, logging) must not kill the heartbeat: with it
+            # dead, quarantined workers stay quarantined FOREVER and the
+            # job narrows to the survivors one fault at a time.
+            logger.warning(
+                "heartbeat pass failed; retrying next interval",
+                exc_info=True,
+            )
 
 
 def run_job(

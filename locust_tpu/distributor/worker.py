@@ -163,10 +163,26 @@ class Worker:
             except OSError:
                 break
             self._conn_slots.acquire()
-            t = threading.Thread(
-                target=self._serve_one, args=(conn,), daemon=True
-            )
-            t.start()
+            try:
+                t = threading.Thread(
+                    target=self._serve_one, args=(conn,), daemon=True
+                )
+                t.start()
+            except Exception as e:  # noqa: BLE001 - spawn can fail under
+                # thread/fd pressure; the ACCEPT LOOP must survive it (a
+                # dead accept loop is a dead worker the master sees only
+                # as timeouts), and it must not leak the slot or conn.
+                self._conn_slots.release()
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                print(
+                    f"[worker] connection thread spawn failed "
+                    f"({type(e).__name__}: {e}); dropped conn from "
+                    f"{peer}, still accepting",
+                    file=sys.stderr, flush=True,
+                )
         self._sock.close()
 
     def _serve_one(self, conn: socket.socket) -> None:

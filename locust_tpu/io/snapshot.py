@@ -176,7 +176,11 @@ class AsyncCheckpointWriter:
                     "abandoning the in-flight write (daemon thread)"
                 )
         except Exception:  # pragma: no cover - flush never raises here
-            pass
+            logger.warning(
+                "async checkpoint close: flush raised unexpectedly "
+                "(contract says it never does); abandoning the in-flight "
+                "write", exc_info=True,
+            )
         with self._cond:
             self._closed = True
             self._cond.notify_all()

@@ -554,7 +554,7 @@ class _RunCtx:
 
             try:
                 tf_delta = term_doc_counts(delta_rows, ids[n_old:], cfg)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # locust: noqa[R017] loss condition = documented bail to the naive recompute, which raises the canonical error for the full corpus — nothing is lost silently
                 # The delta fold hit a loss condition (overflow /
                 # capacity — term_doc_counts raises rather than
                 # truncate).  Bail so the NAIVE path recomputes and

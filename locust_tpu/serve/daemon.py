@@ -1212,7 +1212,11 @@ class ServeDaemon:
             from locust_tpu.plan import distribute, from_json
 
             return distribute.plan_shape(from_json(job.spec.plan))
-        except Exception:  # noqa: BLE001 - unrecognized plan = solo path
+        except Exception as e:  # noqa: BLE001 - unrecognized plan = solo
+            logger.debug(
+                "plan job %s not distributable (%s: %s); solo engine",
+                job.job_id, type(e).__name__, e,
+            )
             return None
 
     def _plan_distributable(self, job: Job) -> bool:
@@ -1894,7 +1898,12 @@ class ServeDaemon:
                         if (hashlib.sha256(data).hexdigest()
                                 != ref["sha256"]):
                             raise ValueError("partition sha drifted")
-                except Exception:  # noqa: BLE001 - damaged = recompute
+                except Exception as e:  # noqa: BLE001 - damaged = recompute
+                    logger.warning(
+                        "plan resume: damaged stage record skipped "
+                        "(%s: %s); that split recomputes",
+                        type(e).__name__, e,
+                    )
                     continue
                 map_done[s] = dict(st)
                 part_files.update(str(p["path"]) for p in parts)

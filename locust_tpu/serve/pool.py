@@ -491,7 +491,11 @@ class WorkerPool:
             reply = worker.rpc(
                 {"cmd": "serve_stats"}, self.secret, min(self.rpc_timeout, 2.0)
             )
-        except Exception:  # noqa: BLE001 - seeding is best-effort
+        except Exception as e:  # noqa: BLE001 - seeding is best-effort
+            logger.debug(
+                "affinity seed from %s skipped (%s: %s); re-learned "
+                "from dispatches", worker.name, type(e).__name__, e,
+            )
             return 0
         shapes = reply.get("warm_shapes") or []
         n = 0

@@ -379,6 +379,25 @@ class ReplicationShipper:
         mangled = plan.mutate(rule, text.encode())
         return mangled.decode("utf-8", "replace"), False
 
+    def _chaos_spill(self, sha: str, data):
+        """The ``serve.ship`` site on the SPILL path (cmd="spill"):
+        (possibly mangled bytes, dropped?).  A mangled spill fails the
+        standby's verify-then-write sha check (journal.store_spill), so
+        it stays in ``need_spills`` and is re-asked on the next ship —
+        corruption converges through re-request, never a bad write."""
+        rule = faultplan.fire("serve.ship", cmd="spill", sha=sha, n=1)
+        if rule is None:
+            return data, False
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+            return data, False
+        if rule.action == "drop":
+            return data, True
+        if data is None:
+            return data, False
+        plan = faultplan.active()
+        return plan.mutate(rule, data), False
+
     def _ship_once(self) -> None:
         if self._catchup_due():
             self._catchup()
@@ -491,6 +510,15 @@ class ReplicationShipper:
         for sha in shas:
             sha = str(sha)
             data = self.journal.read_spill(sha)
+            data, dropped = self._chaos_spill(sha, data)
+            if dropped:
+                # In-flight loss must not be silent: raising sends _run
+                # through its retry path (need_catchup + backoff), and
+                # the standby re-asks for the sha it still lacks.
+                raise RuntimeError(
+                    f"spill {sha[:12]} dropped in flight; standby still "
+                    "awaits it"
+                )
             req = {
                 "cmd": "ship_spill",
                 protocol.EPOCH_KEY: int(self._epoch_fn()),

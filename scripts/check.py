@@ -3,9 +3,11 @@
 
     env JAX_PLATFORMS=cpu python scripts/check.py [--fast]
 
-Runs (1) the two-phase invariant checker (R001-R015) over the configured
-paths (exit 1 on new findings — docs/ANALYSIS.md) including a SARIF
-emission round-trip, (2) tests/test_analysis.py, which includes the
+Runs (1) the two-phase invariant checker (R001-R018) over the configured
+paths (exit 1 on new findings — docs/ANALYSIS.md), with a --changed
+pre-gate (findings on diff-touched lines reported first) and a SARIF
+emission round-trip archived to the configured artifact path,
+(2) tests/test_analysis.py, which includes the
 repo-wide gate test, and (3) a small traced engine run whose exported
 timeline is validated against locust_tpu/obs/trace.schema.json (the obs
 contract, docs/OBSERVABILITY.md) — in a subprocess with a pinned env, so
@@ -29,8 +31,28 @@ def main(argv=None) -> int:
     # In-process: the analyzer imports no checked code (and no jax).
     sys.path.insert(0, REPO)
     from locust_tpu.analysis import run_analysis
+    from locust_tpu.analysis.core import changed_lines, scope_to_changed
 
     result = run_analysis(root=REPO)
+
+    # --changed pre-gate: the findings on lines YOU touched, reported
+    # FIRST — the thing a dev iterating on a diff actually wants to see
+    # before the whole-tree report.  Same run (analysis is always
+    # whole-program; the scope only narrows what is reported), so the
+    # pre-gate costs nothing.  Skipped without complaint when git can't
+    # diff (detached tmp checkouts).
+    try:
+        scoped = scope_to_changed(result, changed_lines(REPO, "HEAD"))
+        if scoped.new:
+            print("[check] pre-gate: new finding(s) on changed lines:",
+                  file=sys.stderr)
+            for f in scoped.new:
+                print(f"  {f.format()}", file=sys.stderr)
+        else:
+            print("[check] pre-gate: changed lines clean", file=sys.stderr)
+    except ValueError as e:
+        print(f"[check] pre-gate skipped ({e})", file=sys.stderr)
+
     for f in result.findings:
         print(f.format(), file=sys.stderr)
     print(
@@ -40,23 +62,33 @@ def main(argv=None) -> int:
     )
     rc = 1 if result.new else 0
 
-    # SARIF emission round-trip: the CI-annotation surface must stay a
-    # loadable 2.1.0 log whatever the findings are (docs/ANALYSIS.md).
+    # SARIF emission round-trip + archive: the CI-annotation surface must
+    # stay a loadable 2.1.0 log whatever the findings are, and the log is
+    # ARCHIVED (config "sarif_artifact", gitignored) so the last gate
+    # run's findings are inspectable after the fact (docs/ANALYSIS.md).
     import json
-    import tempfile
 
+    from locust_tpu.analysis import config as _cfg
     from locust_tpu.analysis.registry import all_rules
     from locust_tpu.analysis.sarif import write_sarif
 
-    with tempfile.TemporaryDirectory() as td:
-        sarif_path = os.path.join(td, "check.sarif")
-        write_sarif(sarif_path, result,
-                    {rid: r.title for rid, r in all_rules().items()})
-        with open(sarif_path, encoding="utf-8") as fh:
-            doc = json.load(fh)
-        if doc.get("version") != "2.1.0":
-            print("[check] sarif round-trip: bad version", file=sys.stderr)
-            rc = rc or 1
+    sarif_path = os.path.join(
+        REPO, _cfg.load_config(REPO)["sarif_artifact"]
+    )
+    os.makedirs(os.path.dirname(sarif_path), exist_ok=True)
+    write_sarif(sarif_path, result, dict(all_rules()))
+    with open(sarif_path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    drv = doc["runs"][0]["tool"]["driver"]
+    if (
+        doc.get("version") != "2.1.0"
+        or not all("helpUri" in r for r in drv["rules"])
+    ):
+        print("[check] sarif round-trip: bad version or rule metadata",
+              file=sys.stderr)
+        rc = rc or 1
+    else:
+        print(f"[check] sarif archived to {sarif_path}", file=sys.stderr)
     if fast:
         return rc
 

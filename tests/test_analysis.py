@@ -1582,13 +1582,481 @@ def test_r015_mutating_real_rewrite_rules_fails_the_gate(tmp_path):
     assert all("hoist_sink" in f.message for f in res.new)
 
 
+# ------------------------------------------- R016/R017/R018 (rpcflow)
+
+# A minimal but REAL-shaped rpc tier at the canonical rel paths the
+# default registries point at: a protocol module owning the command
+# tuples + the framing leaf, a dispatcher, and a client whose payloads
+# ride a helper one module away (the rpcflow fixpoint under test).
+
+_RPC_PROTOCOL = """
+    EPOCH_KEY = "_epoch"
+    COMMANDS = ("ping", "work")
+    SHIP_COMMANDS = ("ship",)
+
+    def send_frame(sock, payload, secret):
+        return payload
+"""
+
+_RPC_WORKER = """
+    def handle(req):
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return {"status": "ok", "pong": True}
+        if cmd == "work":
+            blocks = req["blocks"]
+            return {"status": "ok", "done": len(blocks)}
+        if cmd == "ship":
+            rec = req["rec"]
+            return {"status": "ok", "applied": bool(rec)}
+        return {"status": "error"}
+"""
+
+_RPC_CLIENT = """
+    from locust_tpu.distributor import protocol
+
+    def rpc(sock, payload, secret):
+        protocol.send_frame(sock, payload, secret)
+        return {"status": "ok"}
+
+    def do_ping(sock, secret):
+        rep = rpc(sock, {"cmd": "ping"}, secret)
+        return rep.get("pong")
+
+    def do_work(sock, blocks, secret):
+        req = {"cmd": "work", "blocks": blocks}
+        rep = rpc(sock, req, secret)
+        return rep.get("done")
+
+    def do_ship(sock, rec, epoch, secret):
+        req = {"cmd": "ship", "rec": rec}
+        req[protocol.EPOCH_KEY] = epoch
+        rep = rpc(sock, req, secret)
+        return rep.get("status")
+"""
+
+
+def _rpc_tree(tmp_path, client=_RPC_CLIENT, worker=_RPC_WORKER,
+              protocol=_RPC_PROTOCOL):
+    _write(tmp_path, "locust_tpu/distributor/protocol.py", protocol)
+    _write(tmp_path, "locust_tpu/distributor/worker.py", worker)
+    _write(tmp_path, "locust_tpu/serve/client.py", client)
+    return ["locust_tpu"]
+
+
+def test_r016_silent_on_agreeing_schemas(tmp_path):
+    paths = _rpc_tree(tmp_path)
+    assert not _run(tmp_path, ["R016"], paths).new
+
+
+def test_r016_fires_on_typoed_send_cmd_never_baselineable(tmp_path):
+    paths = _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace('{"cmd": "ping"}', '{"cmd": "pingg"}'),
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    f = res.new[0]
+    assert "pingg" in f.message and "registry" in f.message
+    assert f.path == "locust_tpu/serve/client.py"
+    assert f.baselineable is False
+
+
+def test_r016_fires_on_registered_cmd_with_no_arm(tmp_path):
+    paths = _rpc_tree(
+        tmp_path,
+        protocol=_RPC_PROTOCOL.replace(
+            '("ping", "work")', '("ping", "work", "orphan")'
+        ),
+        client=_RPC_CLIENT + """
+    def do_orphan(sock, secret):
+        return rpc(sock, {"cmd": "orphan"}, secret)
+""",
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    assert "orphan" in res.new[0].message
+    assert "no" in res.new[0].message and "arm" in res.new[0].message
+    assert res.new[0].baselineable is False
+
+
+def test_r016_fires_on_required_read_no_sender_supplies(tmp_path):
+    # do_work stops sending "blocks"; the handler's req["blocks"] now
+    # raises KeyError on every request — the finding lands at the ARM.
+    paths = _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace(
+            '{"cmd": "work", "blocks": blocks}', '{"cmd": "work"}'
+        ),
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    assert "'blocks'" in res.new[0].message
+    assert res.new[0].path == "locust_tpu/distributor/worker.py"
+
+
+def test_r016_fires_on_dead_payload_key(tmp_path):
+    paths = _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace(
+            '{"cmd": "work", "blocks": blocks}',
+            '{"cmd": "work", "blocks": blocks, "junk": 1}',
+        ),
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    assert "dead payload key 'junk'" in res.new[0].message
+    assert res.new[0].path == "locust_tpu/serve/client.py"
+
+
+def test_r016_fires_on_reply_key_no_arm_produces(tmp_path):
+    paths = _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace(
+            'rep.get("pong")', 'rep.get("pongg")'
+        ),
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    assert "reply key 'pongg'" in res.new[0].message
+
+
+def test_r016_fires_on_unfenced_ship_plane_send(tmp_path):
+    # Drop the epoch from the SHIP_COMMANDS-plane send: fencing drift.
+    paths = _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace(
+            "        req[protocol.EPOCH_KEY] = epoch\n", ""
+        ),
+    )
+    res = _run(tmp_path, ["R016"], paths)
+    assert len(res.new) == 1
+    assert "epoch-fenced cmd 'ship'" in res.new[0].message
+
+
+def test_r016_mutating_real_modules_fails_the_gate(tmp_path):
+    """The acceptance demo on the REAL tree: copy serve/ + distributor/,
+    green as-is; a typo'd send-site cmd and an unfenced ship-plane send
+    each provably fail the gate."""
+    for pkg in ("serve", "distributor"):
+        shutil.copytree(
+            os.path.join(REPO, "locust_tpu", pkg),
+            tmp_path / "locust_tpu" / pkg,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+    paths = ["locust_tpu"]
+    assert not _run(tmp_path, ["R016"], paths).new  # faithful copy: green
+
+    cp = tmp_path / "locust_tpu/serve/client.py"
+    orig = cp.read_text()
+    assert '{"cmd": "ping"}' in orig
+    cp.write_text(orig.replace('{"cmd": "ping"}', '{"cmd": "pingg"}', 1))
+    res = _run(tmp_path, ["R016"], paths)
+    assert [f.path for f in res.new] == ["locust_tpu/serve/client.py"]
+    assert "pingg" in res.new[0].message
+    assert res.new[0].baselineable is False
+    cp.write_text(orig)
+
+    rp = tmp_path / "locust_tpu/serve/replicate.py"
+    fenced = '"cmd": "ship",\n                ' \
+        "protocol.EPOCH_KEY: int(self._epoch_fn()),"
+    text = rp.read_text()
+    assert fenced in text
+    rp.write_text(text.replace(fenced, '"cmd": "ship",', 1))
+    res = _run(tmp_path, ["R016"], paths)
+    assert [f.path for f in res.new] == ["locust_tpu/serve/replicate.py"]
+    assert "epoch-fenced cmd 'ship'" in res.new[0].message
+
+
+def test_rpcflow_resolves_helper_indirection_on_real_tree():
+    """Satellite pin: the facts behind R016 on the actual repo.  The
+    pool's serve_batch dispatch builds its payload in a local dict
+    assignments before handing it to the ``rpc`` helper, which forwards
+    into ``_rpc_one``/``send_frame``; the handler arm lives across the
+    module boundary in distributor/worker.py.  rpcflow must resolve the
+    whole chain CLOSED — every required handler key provably supplied."""
+    from locust_tpu.analysis import rpcflow, rules_rpc
+    from locust_tpu.analysis.core import load_files
+    from locust_tpu.analysis.summaries import build_program
+
+    files = load_files(["locust_tpu"], REPO)
+    program = build_program([f for f in files if f.tree is not None], REPO)
+    rp = rpcflow.get(
+        program, rules_rpc.DEFAULT_SCOPE, rules_rpc.DEFAULT_REGISTRIES,
+        rules_rpc.DEFAULT_SEEDS,
+    )
+
+    sites = [
+        s for s in rp.sites_by_cmd.get("serve_batch", []) if not s.synthetic
+    ]
+    assert len(sites) == 1
+    s = sites[0]
+    assert s.rel == "locust_tpu/serve/pool.py"
+    assert not s.payload.open
+    # Payload keys resolved through the earlier `payload = {...}` /
+    # `payload[...] = ...` assignments, then through the helper hop.
+    assert {"bucket", "jobs", "spill_dir"} <= s.payload.all_keys()
+    assert "_epoch" in s.payload.all_keys()
+    chain = [fn.name for fn in s.fns]
+    assert "rpc" in chain and "_rpc_one" in chain  # helper indirection
+
+    arms = rp.arm_index["serve_batch"]
+    assert [a.rel for a in arms] == ["locust_tpu/distributor/worker.py"]
+    a = arms[0]
+    assert not a.open_reads
+    # Two-sided closure: what the handler demands, the sender carries.
+    assert a.required - rpcflow.WIRE_META_KEYS <= s.payload.all_keys()
+
+    # And the client.py submit path (payload built two assignments
+    # early, three-deep helper chain _rpc_ok -> rpc -> _rpc_one).
+    sub = [
+        s for s in rp.sites_by_cmd.get("submit", [])
+        if s.rel == "locust_tpu/serve/client.py"
+    ]
+    assert len(sub) == 1 and not sub[0].payload.open
+    assert {"tenant", "weight"} <= sub[0].payload.keys
+    assert "corpus_b64" in sub[0].payload.cond  # If-guarded add
+    assert [a.rel for a in rp.arm_index["submit"]] == \
+        ["locust_tpu/serve/daemon.py"] * len(rp.arm_index["submit"])
+
+
+def test_write_baseline_refuses_phantom_cmds(tmp_path):
+    """`--write-baseline` must never bury a dead RPC: a phantom-cmd
+    finding (baselineable=False) refuses the whole write, exit 2."""
+    _rpc_tree(
+        tmp_path,
+        client=_RPC_CLIENT.replace('{"cmd": "ping"}', '{"cmd": "pingg"}'),
+    )
+    _write(tmp_path, "pyproject.toml", """
+        [tool.locust-analysis]
+        paths = ["locust_tpu"]
+    """)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, "-m", "locust_tpu.analysis", "--root",
+         str(tmp_path), "--rule", "R016", "--write-baseline"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refusing" in proc.stderr and "pingg" in proc.stderr
+    assert not (tmp_path / "analysis_baseline.json").exists()
+
+
+def test_r017_fires_on_silent_broad_swallow(tmp_path):
+    _write(tmp_path, "locust_tpu/mod.py", """
+        def poll(q):
+            try:
+                q.drain()
+            except Exception:
+                pass
+    """)
+    res = _run(tmp_path, ["R017"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "swallows" in res.new[0].message
+
+
+def test_r017_silent_when_swallow_logs_or_uses_exception(tmp_path):
+    _write(tmp_path, "locust_tpu/mod.py", """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def poll(q):
+            try:
+                q.drain()
+            except Exception:
+                logger.warning("drain failed; retrying", exc_info=True)
+
+        def classify(q):
+            try:
+                q.drain()
+            except Exception as e:
+                q.last_error = e
+    """)
+    assert not _run(tmp_path, ["R017"], ["locust_tpu"]).new
+
+
+def test_r017_fires_on_unprotected_thread_entry(tmp_path):
+    _write(tmp_path, "locust_tpu/mod.py", """
+        import threading
+
+        def loop(q):
+            while True:
+                q.step()
+
+        def start(q):
+            threading.Thread(target=loop, args=(q,), daemon=True).start()
+    """)
+    res = _run(tmp_path, ["R017"], ["locust_tpu"])
+    assert len(res.new) == 1
+    assert "thread entry 'loop'" in res.new[0].message
+
+
+def test_r017_silent_when_entry_protected_one_hop_away(tmp_path):
+    _write(tmp_path, "locust_tpu/mod.py", """
+        import logging
+        import threading
+
+        logger = logging.getLogger(__name__)
+
+        def loop(q):
+            while True:
+                _safe_step(q)
+
+        def _safe_step(q):
+            try:
+                q.step()
+            except Exception:
+                logger.warning("step failed; loop stays up", exc_info=True)
+
+        def start(q):
+            threading.Thread(target=loop, args=(q,), daemon=True).start()
+    """)
+    assert not _run(tmp_path, ["R017"], ["locust_tpu"]).new
+
+
+def test_r018_fires_on_chaos_blind_data_plane_cmd(tmp_path):
+    _rpc_tree(
+        tmp_path,
+        protocol=_RPC_PROTOCOL.replace(
+            '("ping", "work")', '("ping", "fetch")'
+        ),
+        worker="""
+    def handle(req):
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return {"status": "ok", "pong": True}
+        if cmd == "fetch":
+            return _fetch(req)
+        return {"status": "error"}
+
+    def _fetch(req):
+        path = req["path"]
+        return {"status": "ok", "data": path}
+""",
+        client="""
+    from locust_tpu.distributor import protocol
+
+    def rpc(sock, payload, secret):
+        protocol.send_frame(sock, payload, secret)
+        return {"status": "ok"}
+
+    def do_fetch(sock, path, secret):
+        return rpc(sock, {"cmd": "fetch", "path": path}, secret)
+
+    def do_ship(sock, rec, epoch, secret):
+        from locust_tpu.utils import faultplan
+        faultplan.fire("serve.ship", rec=rec)
+        req = {"cmd": "ship", "rec": rec}
+        req[protocol.EPOCH_KEY] = epoch
+        return rpc(sock, req, secret)
+""",
+    )
+    res = _run(tmp_path, ["R018"], ["locust_tpu"])
+    # fetch (data plane) has no reachable hook; ship's SEND PATH has one
+    # (coverage can come from either side of the wire).
+    assert len(res.new) == 1
+    assert "data-plane cmd 'fetch'" in res.new[0].message
+    assert res.new[0].path == "locust_tpu/distributor/worker.py"
+
+
+def test_r018_silent_when_handler_reaches_a_hook(tmp_path):
+    _rpc_tree(
+        tmp_path,
+        protocol=_RPC_PROTOCOL.replace(
+            '("ping", "work")', '("ping", "fetch")'
+        ),
+        worker="""
+    from locust_tpu.utils import faultplan
+
+    def handle(req):
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return {"status": "ok", "pong": True}
+        if cmd == "fetch":
+            return _fetch(req)
+        if cmd == "ship":
+            return _apply_ship(req)
+        return {"status": "error"}
+
+    def _fetch(req):
+        path = req["path"]
+        faultplan.damage_file("dist.fetch", path)
+        return {"status": "ok", "data": path}
+
+    def _apply_ship(req):
+        rec = req["rec"]
+        faultplan.fire("serve.ship", rec=rec)
+        return {"status": "ok"}
+""",
+        client="""
+    from locust_tpu.distributor import protocol
+
+    def rpc(sock, payload, secret):
+        protocol.send_frame(sock, payload, secret)
+        return {"status": "ok"}
+
+    def do_fetch(sock, path, secret):
+        return rpc(sock, {"cmd": "fetch", "path": path}, secret)
+
+    def do_ship(sock, rec, epoch, secret):
+        req = {"cmd": "ship", "rec": rec}
+        req[protocol.EPOCH_KEY] = epoch
+        return rpc(sock, req, secret)
+""",
+    )
+    assert not _run(tmp_path, ["R018"], ["locust_tpu"]).new
+
+
+def test_r018_fires_on_unclassified_cmd(tmp_path):
+    _rpc_tree(
+        tmp_path,
+        protocol=_RPC_PROTOCOL.replace(
+            '("ping", "work")', '("ping", "mystery")'
+        ),
+        worker="""
+    def handle(req):
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return {"status": "ok", "pong": True}
+        if cmd == "mystery":
+            return {"status": "ok"}
+        if cmd == "ship":
+            return {"status": "ok"}
+        return {"status": "error"}
+""",
+        client="""
+    from locust_tpu.distributor import protocol
+
+    def rpc(sock, payload, secret):
+        protocol.send_frame(sock, payload, secret)
+        return {"status": "ok"}
+
+    def do_ship(sock, rec, epoch, secret):
+        req = {"cmd": "ship", "rec": rec}
+        req[protocol.EPOCH_KEY] = epoch
+        return rpc(sock, req, secret)
+
+    def chaos_demo():
+        from locust_tpu.utils import faultplan
+        faultplan.fire("serve.ship", cmd="demo")
+""",
+    )
+    res = _run(tmp_path, ["R018"], ["locust_tpu"])
+    findings = [f for f in res.new if "mystery" in f.message]
+    assert len(findings) == 1
+    assert "no plane classification" in findings[0].message
+
+
 # ------------------------------------------------------- registry + CLI
 
 
 def test_registry_is_closed_and_complete():
     assert sorted(all_rules()) == [
         "R001", "R002", "R003", "R004", "R005", "R006", "R007", "R008",
-        "R009", "R010", "R011", "R012", "R013", "R014", "R015",
+        "R009", "R010", "R011", "R012", "R013", "R014", "R015", "R016",
+        "R017", "R018",
     ]
     with pytest.raises(ValueError, match="unknown rule"):
         get_rules(["R042"])
@@ -1605,6 +2073,13 @@ def test_cli_json_gate_green_on_repo(tmp_path):
     report = json.loads(proc.stdout)
     assert report["new"] == 0
     assert report["rules"] == sorted(all_rules())
+    # Per-rule wall time: one entry per selected rule, so a perf
+    # regression against the <10s self-perf pin is attributable.
+    assert sorted(report["rule_ms"]) == report["rules"]
+    assert all(
+        isinstance(v, (int, float)) and v >= 0
+        for v in report["rule_ms"].values()
+    )
 
 
 def test_cli_rule_filter_and_unknown_rule(tmp_path):
@@ -1735,6 +2210,23 @@ def test_sarif_schema_shape(tmp_path):
     assert result["baselineState"] == "new"
 
 
+def test_sarif_rule_entries_carry_help_uri_and_level(tmp_path):
+    """Passing rule CLASSES (the CLI's catalog) decorates each rule
+    entry with helpUri (docs/ANALYSIS.md anchor) and a default level;
+    bare-title catalogs (the legacy shape above) still work."""
+    from locust_tpu.analysis.sarif import sarif_report
+
+    _write(tmp_path, "locust_tpu/hot.py", _R003_HOT)
+    res = _run(tmp_path, ["R003"], ["locust_tpu"])
+    doc = sarif_report(res, dict(all_rules()))
+    rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    assert [r["id"] for r in rules] == sorted(all_rules())
+    for r in rules:
+        assert r["helpUri"].startswith("docs/ANALYSIS.md#")
+        assert r["defaultConfiguration"]["level"] == "error"
+        assert r["shortDescription"]["text"]
+
+
 def test_cli_sarif_writes_parseable_log(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
     out = tmp_path / "findings.sarif"
@@ -1746,7 +2238,10 @@ def test_cli_sarif_writes_parseable_log(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(out.read_text())
     assert doc["version"] == "2.1.0"
-    assert doc["runs"][0]["tool"]["driver"]["name"] == "locust-analysis"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "locust-analysis"
+    # The CLI passes rule classes: every entry carries a helpUri.
+    assert all("helpUri" in r for r in driver["rules"])
 
 
 # ----------------------------------------------------- two-phase engine
@@ -1761,8 +2256,10 @@ def test_full_repo_run_is_fast_and_parses_each_file_once():
     import time as _time
 
     from locust_tpu.analysis import core as acore
+    from locust_tpu.analysis import rpcflow as arpc
 
     acore.reset_parse_count()
+    arpc.reset_build_count()
     t0 = _time.perf_counter()
     res = run_analysis(root=REPO)
     elapsed = _time.perf_counter() - t0
@@ -1770,6 +2267,12 @@ def test_full_repo_run_is_fast_and_parses_each_file_once():
     assert acore.parse_count() == res.n_files, (
         f"{acore.parse_count()} parses for {res.n_files} files — "
         "a rule is re-parsing instead of reusing phase-1 trees"
+    )
+    # The message-flow economy rides the same pin: R016 and R018 share
+    # ONE RpcProgram build per run (rpcflow.get caches on the Program).
+    assert arpc.build_count() == 1, (
+        f"{arpc.build_count()} rpcflow builds in one run — R016/R018 "
+        "stopped sharing the cached RpcProgram"
     )
 
 

@@ -120,6 +120,49 @@ def test_worker_survives_malformed_frames():
         _shutdown(w)
 
 
+def test_worker_accept_loop_survives_thread_spawn_failure(monkeypatch):
+    """Regression (PR 18, R017): a connection thread that fails to SPAWN
+    must not kill the accept loop, and must release its connection slot
+    and close the orphaned socket.  max_connections=1 makes a leaked
+    slot a deadlock: three consecutive spawn failures would wedge the
+    acquire forever if any release were missed."""
+    import threading
+
+    import locust_tpu.distributor.worker as worker_mod
+
+    w = Worker(secret=SECRET, max_connections=1)
+    w.serve_in_thread()
+    real_thread = threading.Thread
+    fails = {"left": 3}
+
+    class FlakyThread(real_thread):
+        def __init__(self, *args, target=None, **kwargs):
+            if (
+                getattr(target, "__name__", "") == "_serve_one"
+                and fails["left"] > 0
+            ):
+                fails["left"] -= 1
+                raise RuntimeError("injected spawn failure")
+            super().__init__(*args, target=target, **kwargs)
+
+    try:
+        monkeypatch.setattr(worker_mod.threading, "Thread", FlakyThread)
+        while fails["left"]:
+            before = fails["left"]
+            # The dropped connection surfaces client-side as a closed
+            # socket mid-rpc; the worker must already be accepting again.
+            with pytest.raises(Exception):
+                master._rpc(w.addr, {"cmd": "ping"}, SECRET, timeout=5)
+            assert fails["left"] == before - 1
+        monkeypatch.setattr(worker_mod.threading, "Thread", real_thread)
+        assert master._rpc(
+            w.addr, {"cmd": "ping"}, SECRET, timeout=5
+        )["pong"] is True
+    finally:
+        monkeypatch.setattr(worker_mod.threading, "Thread", real_thread)
+        _shutdown(w)
+
+
 def test_worker_fetch_path_containment(tmp_path):
     w = Worker(secret=SECRET)
     w.serve_in_thread()

@@ -1739,6 +1739,34 @@ def test_chaos_serve_ship_corrupt_never_applied_then_converges(tmp_path):
         standby.close()
 
 
+def test_chaos_serve_ship_spill_drop_retried_until_standby_has_it(tmp_path):
+    """serve.ship drop on the SPILL path (cmd="spill"): the corpus
+    bytes vanish in flight.  Regression (PR 18, found by R018 — the
+    spill leg was the one chaos-blind hop on the data plane): a dropped
+    spill must raise into the shipper's retry ladder, and the standby
+    re-asks for the sha until it actually holds the bytes — never a
+    silent "sent" for bytes that never arrived."""
+    from locust_tpu.serve import ServeClient
+
+    primary, standby = _ha_chaos_pair(tmp_path)
+    try:
+        primary.scheduler.pause()  # keep the job LIVE: its spill must ship
+        p = plan([{"site": "serve.ship", "action": "drop",
+                   "match": {"cmd": "spill"}, "times": 1}])
+        with faultplan.active_plan(p):
+            client = ServeClient(primary.addr, SECRET, timeout=30.0)
+            jid = client.submit(corpus=SERVE_CORPUS, config=SERVE_CFG,
+                                no_cache=True)["job_id"]
+            assert _ship_converged(primary, standby, 1)
+        assert p.rules[0].fired == 1
+        live = standby.journal.live_records()
+        assert [r["job_id"] for r in live] == [jid]
+        assert standby.journal.spill_exists(live[0]["corpus_sha"])
+    finally:
+        primary.close()
+        standby.close()
+
+
 def test_chaos_serve_ship_delay_lag_reported_admits_unaffected(tmp_path):
     """serve.ship delay: a slow standby link.  Admits must not slow
     down (shipping is off the admit path by construction) and the lag
