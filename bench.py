@@ -597,6 +597,7 @@ def _stream_stats(eng, rows) -> dict:
             "overlap_pct": round(100 * (1 - (stall + mark) / total), 2),
             "distinct": ck.num_segments,
             "distinct_matches": ck.num_segments == plain.num_segments,
+            "fused": _stream_fused_row(eng.cfg, srows, bl),
         }
         print(
             f"[bench] stream: plain {plain_s:.2f}s vs ckpt {ck_s:.2f}s "
@@ -608,6 +609,60 @@ def _stream_stats(eng, rows) -> dict:
         return out
     except Exception as e:  # noqa: BLE001 - the headline line comes first
         return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _stream_fused_row(cfg, srows, bl: int) -> dict:
+    """Megakernel v2 streaming row: the persistent streaming kernel
+    (``sort_mode="fused"`` through ``run_stream``) vs plain hasht over
+    the SAME block stream, identity asserted in-row — the tables must
+    be bit-identical, a divergence fails the whole stream sub-dict
+    loudly rather than landing a passing row.  Off-TPU the walls are
+    honest interpret-mode numbers (the kernel re-traces per grid step
+    on CPU) and the row says so (``interpret``); when the engine's gate
+    demotes (e.g. bench block_lines past the interpret cap) the row
+    records ``demoted=True`` with no speedup claim.  Block count is
+    bounded: this row's evidence is identity + formulation, the
+    throughput headline belongs to the main bench."""
+    import dataclasses
+
+    import jax
+
+    from locust_tpu.engine import MapReduceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_blocks = min(srows.shape[0] // bl or 1, 24 if on_tpu else 4)
+    frows = srows[: n_blocks * bl]
+
+    def blocks():
+        for i in range(0, frows.shape[0], bl):
+            yield frows[i : i + bl]
+
+    f_eng = MapReduceEngine(dataclasses.replace(cfg, sort_mode="fused"))
+    h_eng = MapReduceEngine(dataclasses.replace(cfg, sort_mode="hasht"))
+    f_eng.run_stream(blocks())  # warm both executables
+    h_eng.run_stream(blocks())
+    t0 = time.perf_counter()
+    f_res = f_eng.run_stream(blocks())
+    fused_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    h_res = h_eng.run_stream(blocks())
+    hasht_s = time.perf_counter() - t0
+    assert f_res.to_host_pairs() == h_res.to_host_pairs(), (
+        "fused streaming table diverged from hasht"
+    )
+    fstats = dict(f_res.stream.get("fused") or {})
+    return {
+        "formulation": f_res.fused_kernel,
+        "demoted": bool(f_res.fused_demoted),
+        "interpret": not on_tpu,
+        "blocks": n_blocks,
+        "seg_blocks": fstats.get("seg_blocks"),
+        "segments": fstats.get("segments"),
+        "fused_s": round(fused_s, 3),
+        "hasht_s": round(hasht_s, 3),
+        "speedup": round(hasht_s / fused_s, 2) if fused_s > 0 else None,
+        "identical": True,  # asserted above
+    }
 
 
 def _percentile(xs: list, q: float) -> float | None:
@@ -1341,11 +1396,19 @@ def _plan_optimizer_rows(cfg, lines, rows) -> dict:
     f_s, f_res = best_of(lambda: fcp.run(frows, render=False))
     n_s, n_res = best_of(lambda: ncp.run(frows, render=False))
     assert f_res.value == n_res.value, "fuse_fold_kernel diverged"
+    # Megakernel v2: which fused formulation this row actually measured
+    # — "batch" (one whole-corpus launch), "stream" (the persistent
+    # streaming kernel), or None with demoted=True when the engine's
+    # gate turned the kernel off and folded exactly like hasht
+    # (mesh-demoted is the distributed engines' spelling of the same).
+    f_rr = getattr(f_res, "run_result", None)
     fused = {
         "rewrite_fired": bool(fcp.optimized.fuse_kernel),
         "kernel_engaged": bool(
             fcp._wordcount_engine()._fused_kernel_on
         ),
+        "formulation": getattr(f_rr, "fused_kernel", None),
+        "demoted": bool(getattr(f_rr, "fused_demoted", False)),
         "backend": jax.default_backend(),
         "lines": int(frows.shape[0]),
         "fused_s": round(f_s, 3),

@@ -289,6 +289,44 @@ def fused_grid(slots: int | None = None) -> tuple[int, int]:
     return t_hi, t_lo
 
 
+# Blocks folded per PERSISTENT-KERNEL segment (megakernel v2 streaming
+# formulation, ops/pallas/fused_fold.py).  run_stream groups this many
+# staged blocks into ONE kernel launch whose table planes stay VMEM-
+# resident across the whole segment, amortizing the per-block
+# acc->settle->acc HBM round-trip by this factor.  Clamped at runtime by
+# :func:`fused_stream_seg_blocks` (f32 count-plane exactness + off-TPU
+# interpret-cost caps), so a large value is safe — it just saturates the
+# clamp.
+FUSED_STREAM_BLOCKS: int = int(
+    _os.environ.get("LOCUST_FUSED_STREAM_BLOCKS", 8)
+)
+if FUSED_STREAM_BLOCKS < 1:
+    raise ValueError(
+        f"LOCUST_FUSED_STREAM_BLOCKS must be >= 1, got {FUSED_STREAM_BLOCKS}"
+    )
+
+
+def fused_stream_seg_blocks(
+    emits_per_block: int, block_lines: int, on_tpu: bool
+) -> int:
+    """Blocks per persistent-kernel streaming segment, clamped for
+    exactness and interpret cost.
+
+    The kernel counts in f32 planes, exact only below 2**24, and the
+    per-segment emit budget is ``seg_blocks * emits_per_block`` — so the
+    segment is clamped to keep that product under 2**24 (the same bound
+    fused_engine_eligible enforces per block).  Off-TPU the interpreter
+    re-traces per grid step, so the segment additionally respects
+    FUSED_INTERPRET_MAX_LINES over its total line count.  jax-free so
+    utils/roofline.py amortizes the v2 stream model off the SAME clamp
+    the engine runs with."""
+    cap = max(1, ((1 << 24) - 1) // max(1, emits_per_block))
+    seg = min(FUSED_STREAM_BLOCKS, cap)
+    if not on_tpu and block_lines > 0:
+        seg = min(seg, max(1, FUSED_INTERPRET_MAX_LINES // block_lines))
+    return max(1, seg)
+
+
 def fused_table_layout(slots: int | None = None) -> tuple[int, int]:
     """[t_hi, t_lo] PHYSICAL plane layout for a ``slots``-slot kernel
     table (default FUSED_TABLE_SLOTS): the :func:`fused_grid`

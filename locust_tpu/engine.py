@@ -142,6 +142,16 @@ class RunResult:
     # stats (backpressure_stall_ms, ckpt.{mark_ms,written,skipped,
     # max_lag,...}) — the numbers behind bench.py's "stream" sub-dict.
     stream: dict | None = None
+    # Which megakernel formulation actually served this run (ISSUE 19
+    # operator visibility): "batch" = per-block fused_block_preagg,
+    # "stream" = the persistent streaming segments, None = no kernel
+    # (non-fused sort modes, or a demoted fused request).
+    fused_kernel: str | None = None
+    # True iff sort_mode="fused" was REQUESTED but the kernel did not
+    # engage (eligibility miss / off-TPU interpret cap / mesh-on-CPU) —
+    # the fold ran hasht-identically.  The mesh engines mirror this on
+    # DistributedResult; previously the demotion was silent.
+    fused_demoted: bool = False
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Decode the table; re-merge hash-collision duplicates; key-sort.
@@ -315,16 +325,32 @@ class MapReduceEngine:
         # fully static, decided (and logged) once here, never inside
         # traced code.
         self._fused_kernel_on = False
+        self._fused_demoted = False
+        # Persistent streaming segment length (megakernel v2): how many
+        # staged blocks run_stream groups into ONE kernel launch with the
+        # table VMEM-resident across the whole segment.  1 = per-block
+        # (the v1 formulation); the clamp keeps the per-segment emit
+        # budget f32-exact and bounds off-TPU interpret cost
+        # (config.fused_stream_seg_blocks).
+        self._fused_stream_seg = 1
         if mode == "fused":
+            from locust_tpu.config import fused_stream_seg_blocks
             from locust_tpu.ops.pallas.fused_fold import (
                 fused_engine_eligible,
             )
 
             ok, why = fused_engine_eligible(cfg, raw_map_fn, self.combine)
             self._fused_kernel_on = ok
+            self._fused_demoted = not ok
             if not ok:
                 logger.info("sort_mode='fused': kernel not engaged — %s",
                             why)
+            else:
+                self._fused_stream_seg = fused_stream_seg_blocks(
+                    cfg.emits_per_block,
+                    cfg.block_lines,
+                    jax.default_backend() == "tpu",
+                )
 
         def fold_block(acc: KVBatch, lines: jax.Array):
             """Map one block and merge its emits into the running table.
@@ -393,6 +419,47 @@ class MapReduceEngine:
             merged, distinct = fold_into(acc, kv, tsize, combine, mode)
             return merged, overflow, distinct
 
+        def fold_segment(acc: KVBatch, seg_lines: jax.Array):
+            """Persistent-kernel streaming fold (megakernel v2): ONE
+            kernel launch over ``[seg_blocks * block_lines, width]``
+            staged lines, table planes VMEM-resident across the whole
+            segment (fused_block_preagg already supports any
+            tile-multiple line count; its constant-index table BlockSpec
+            IS the persistence).  The acc->settle->acc HBM round-trip
+            and the table flush amortize by the segment length — the v2
+            traffic model in utils/roofline.py.
+
+            Bit-identity carries over from fold_block unchanged: the
+            settlement folds concat(acc, table, residual) through the
+            same aggregate_exact, and hasht's final table is a pure
+            function of the distinct-key set + per-key totals — which
+            are grouping-invariant (emit overflow is per-line, counts
+            are per-key sums).  A residual overflow re-folds the WHOLE
+            segment through the stock path (map over the segment lines
+            is exact at any length), so both cond branches stay exact.
+            """
+            from locust_tpu.ops.pallas.fused_fold import (
+                fused_block_preagg,
+            )
+
+            interpret = jax.default_backend() != "tpu"
+            ktab, kresid, overflow, bad = fused_block_preagg(
+                seg_lines, cfg, interpret=interpret
+            )
+
+            def fused_path(acc_in):
+                return fold_into(
+                    acc_in, KVBatch.concat(ktab, kresid), tsize,
+                    combine, mode,
+                )
+
+            def stock_path(acc_in):
+                kv, _ = map_fn(seg_lines, cfg)
+                return fold_into(acc_in, kv, tsize, combine, mode)
+
+            merged, distinct = jax.lax.cond(bad, stock_path, fused_path, acc)
+            return merged, overflow, distinct
+
         def scan_blocks_into(acc0: KVBatch, blocks: jax.Array):
             """Whole-corpus pipeline in ONE dispatch: fold blocks with lax.scan.
 
@@ -434,6 +501,15 @@ class MapReduceEngine:
             jax.jit(stock_fold, donate_argnums=donate)
             if self._fused_kernel_on
             else self._fold_block
+        )
+        # Streaming-segment executable (megakernel v2): traced lazily on
+        # first run_stream use; None when the kernel is off or the clamp
+        # leaves segments at one block (then run_stream's per-block loop
+        # is already optimal).
+        self._fold_segment = (
+            jax.jit(fold_segment, donate_argnums=donate)
+            if self._fused_kernel_on and self._fused_stream_seg > 1
+            else None
         )
         self._scan_blocks_into = jax.jit(scan_blocks_into, donate_argnums=donate)
         # The export/compile-check surface (__graft_entry__.entry, the
@@ -671,6 +747,14 @@ class MapReduceEngine:
             pump = _CheckpointPump(
                 self, state_path, fingerprint, self.cfg.async_checkpoint
             )
+        if self._fold_segment is not None:
+            # Megakernel v2 persistent streaming: segments of
+            # _fused_stream_seg staged blocks per kernel launch, table
+            # VMEM-resident across each segment (fold_segment docstring).
+            return self._run_stream_fused(
+                blocks, acc, overflow, max_distinct, start_block, pump,
+                every,
+            )
         ring = (
             _StagingRing(self.STREAM_DISPATCH_DEPTH + 1, bl, w)
             if self.cfg.stream_staging_ring
@@ -756,6 +840,137 @@ class MapReduceEngine:
         return self._finish(
             acc, max_distinct, int(overflow), StageTimes(0, total_ms, 0),
             stream=stream,
+        )
+
+    def _run_stream_fused(
+        self, blocks, acc, overflow, max_distinct, start_block: int,
+        pump, every: int,
+    ) -> RunResult:
+        """run_stream's persistent-kernel tail (megakernel v2).
+
+        Blocks stage into ``[seg_blocks * block_lines, width]`` segment
+        buffers (a ring sized like _StagingRing when
+        cfg.stream_staging_ring) and each FULL segment folds in ONE
+        ``_fold_segment`` dispatch — the kernel table stays VMEM-resident
+        across the whole segment, so the per-block acc->settle->acc HBM
+        round-trip and table flush amortize by ``seg_blocks``.  The
+        trailing partial segment zero-pads its unfilled blocks (zero
+        lines tokenize to nothing, the _blocks padding contract), so one
+        executable serves every segment.  Checkpoint marks land at
+        segment boundaries — which ARE block boundaries — once ``every``
+        blocks have elapsed since the last mark, and resume re-forms
+        segments from the restored block cursor: the fold is a pure
+        function of the line multiset, so the regrouped resume stays
+        byte-identical (tests/test_fused_fold.py crash-resume pin).
+        Backpressure/stall accounting mirror run_stream at segment
+        granularity.
+        """
+        import collections as _collections
+
+        from locust_tpu.parallel.shuffle import normalize_round_chunk
+
+        bl, w = self.cfg.block_lines, self.cfg.line_width
+        seg = self._fused_stream_seg
+        n_slots = self.STREAM_DISPATCH_DEPTH + 1
+        bufs = (
+            [np.zeros((seg * bl, w), np.uint8) for _ in range(n_slots)]
+            if self.cfg.stream_staging_ring
+            else None
+        )
+        state = {
+            "acc": acc, "overflow": overflow,
+            "max_distinct": max_distinct, "slot": 0, "segments": 0,
+            "stall_ms": 0.0, "last_mark": start_block,
+        }
+        flush_ms = 0.0
+        inflight: _collections.deque = _collections.deque()
+        t0 = time.perf_counter()
+
+        def next_buf() -> np.ndarray:
+            if bufs is None:
+                return np.zeros((seg * bl, w), np.uint8)
+            buf = bufs[state["slot"]]
+            state["slot"] = (state["slot"] + 1) % n_slots
+            return buf
+
+        def dispatch(buf: np.ndarray, n_filled: int, seg_end: int) -> None:
+            if n_filled < seg and bufs is not None:
+                buf[n_filled * bl:, :] = 0  # ring reuse: clear stale tail
+            with obs.span("stream.block", i=seg_end - 1,
+                          staging="ring" if bufs is not None else "alloc",
+                          seg_blocks=n_filled):
+                acc2, blk_overflow, distinct = self._fold_segment(
+                    state["acc"], jnp.asarray(buf)
+                )
+            state["acc"] = acc2
+            state["overflow"] = state["overflow"] + blk_overflow
+            state["max_distinct"] = jnp.maximum(
+                state["max_distinct"], distinct
+            )
+            state["segments"] += 1
+            inflight.append(blk_overflow)
+            if len(inflight) > self.STREAM_DISPATCH_DEPTH:
+                t_sync = time.perf_counter()
+                jax.block_until_ready(inflight.popleft())  # locust: noqa[R003] bounded-inflight backpressure: sync caps device queue depth, overlap stays STREAM_DISPATCH_DEPTH deep
+                sync_ms = (time.perf_counter() - t_sync) * 1e3
+                state["stall_ms"] += sync_ms
+                obs.event("stream.stall", block=seg_end - 1,
+                          ms=round(sync_ms, 3))
+                obs.metric_observe("stream.stall_ms", sync_ms)
+            if pump is not None and seg_end - state["last_mark"] >= every:
+                pump.mark(state["acc"], seg_end, state["overflow"],
+                          state["max_distinct"])
+                state["last_mark"] = seg_end
+
+        i = start_block - 1
+        fill = 0
+        cur: np.ndarray | None = None
+        try:
+            for i, blk in enumerate(blocks):
+                if i < start_block:  # resume: re-read, don't re-fold
+                    continue
+                if fill == 0:
+                    cur = next_buf()
+                normalize_round_chunk(
+                    blk, bl, w, out=cur[fill * bl:(fill + 1) * bl]
+                )
+                fill += 1
+                if fill == seg:
+                    dispatch(cur, fill, i + 1)
+                    fill = 0
+            if fill:
+                dispatch(cur, fill, i + 1)
+            if pump is not None and i + 1 > state["last_mark"]:
+                pump.mark(state["acc"], i + 1, state["overflow"],
+                          state["max_distinct"])
+            if pump is not None:
+                flush_ms = pump.finish()
+        finally:
+            if pump is not None:
+                pump.close()
+        jax.block_until_ready(state["acc"].key_lanes)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        obs.metric_inc("stream.blocks", max(0, i + 1 - start_block))
+        stream = {
+            "blocks": max(0, i + 1 - start_block),
+            "staging_ring": bufs is not None,
+            "donate_fold": self.cfg.donate_fold,
+            "backpressure_stall_ms": round(state["stall_ms"], 3),
+            "total_ms": round(total_ms, 3),
+            "fused": {
+                "formulation": "stream",
+                "seg_blocks": seg,
+                "segments": state["segments"],
+                "interpret": jax.default_backend() != "tpu",
+            },
+        }
+        if pump is not None:
+            stream["ckpt"] = dict(
+                pump.stats(), every=every, final_flush_ms=round(flush_ms, 3)
+            )
+        return self._finish(
+            state["acc"], state["max_distinct"], int(state["overflow"]),
+            StageTimes(0, total_ms, 0), stream=stream, fused_kernel="stream",
         )
 
     def _load_state(self, state_path: str, fingerprint: str, acc: KVBatch):
@@ -1007,7 +1222,8 @@ class MapReduceEngine:
         return acc, on_cpu, cpu_dev
 
     def _finish(self, acc, num_segments, overflow, times,
-                stream: dict | None = None) -> RunResult:
+                stream: dict | None = None,
+                fused_kernel: str | None = None) -> RunResult:
         if os.environ.get("LOCUST_DEBUG_CHECKS"):
             # Opt-in invariant sweep on the result table (the sanitizer
             # analog, SURVEY.md §5): valid-prefix layout + NUL-padded keys.
@@ -1038,6 +1254,8 @@ class MapReduceEngine:
                 overflow,
                 self.cfg.emits_per_line,
             )
+        if fused_kernel is None and self._fused_kernel_on:
+            fused_kernel = "batch"
         return RunResult(
             table=acc,
             num_segments=min(num, acc.size),
@@ -1046,4 +1264,6 @@ class MapReduceEngine:
             times=times,
             combine=self.combine,
             stream=stream,
+            fused_kernel=fused_kernel,
+            fused_demoted=self._fused_demoted,
         )

@@ -67,6 +67,30 @@ the (cheaper) residual branch.  Both stay exact (identical host pairs);
 only the slot LAYOUT can differ there, and reaching it needs > 4096
 probe-exhausted raw rows in a single fold.
 
+Megakernel v2 adds two more FORMULATIONS of this same kernel — not new
+kernels (both call :func:`fused_block_preagg` unchanged, so the
+bit-identity argument above carries over verbatim):
+
+* **Persistent streaming** (engine.run_stream): the kernel already keeps
+  its table planes at a constant index_map — VMEM-resident across ALL
+  grid steps — and accepts any tile-multiple line count, so the engine
+  feeds it SEGMENTS of ``config.FUSED_STREAM_BLOCKS`` staged blocks per
+  launch.  Pallas double-buffers the per-tile line DMA automatically
+  (indexed input BlockSpec), the bounded residual drains per tile as
+  before, and the acc->settle->acc HBM round-trip plus the table flush
+  amortize by the segment length (utils/roofline.py "fused-stream").
+  Exactness: the per-SEGMENT emit budget must stay < 2^24 for the f32
+  count planes — :func:`config.fused_stream_seg_blocks` clamps the
+  segment to that bound (and to the interpret-cost cap off-TPU).
+* **Mesh-native** (parallel/shuffle.py, parallel/hierarchical.py): the
+  kernel runs per shard UNDER shard_map, replacing map+local-combine in
+  the shuffle step body; the per-shard table+residual settle through the
+  UNCHANGED per-shard merge + hierarchical combine.  TPU-only
+  (:func:`fused_mesh_eligible`): off-TPU the mesh engines demote to
+  plain hasht with an explicit one-time log and a ``fused_demoted``
+  result field — the interpret kernel NEVER runs inside a CPU mesh
+  program (the check_vma segfault class, CLAUDE.md).
+
 Validation off-TPU uses interpret mode strictly under the pinned
 direct-test pattern — NEVER inside a full CPU mesh program (the
 check_vma segfault class, CLAUDE.md); the mesh engines run this mode as
@@ -398,6 +422,46 @@ def fused_engine_eligible(cfg: EngineConfig, map_fn, combine: str):
     return True, ""
 
 
+def fused_mesh_eligible(cfg: EngineConfig, map_fn, combine: str):
+    """Can the MESH engines run their per-shard map+combine through the
+    megakernel?  Returns ``(ok, reason)`` like :func:`fused_engine_eligible`.
+
+    Everything static, decided once at engine construction (the mesh
+    engines log the demotion there and surface it as ``fused_demoted``
+    on DistributedResult — the ISSUE 19 fix for the silent fallback):
+
+    * all single-device checks apply per shard (each shard folds
+      ``cfg.block_lines`` lines per round — the same block the kernel
+      pre-aggregates);
+    * **TPU only**: the interpret-mode kernel inside a full CPU mesh
+      program segfaults XLA's CPU compiler (the check_vma class,
+      CLAUDE.md) — off-TPU the mesh fold stays plain hasht, period.
+      The CPU kernel-under-shard_map path is pinned by a small DIRECT
+      test instead (tests/test_fused_fold.py);
+    * the pre-aggregated rows (table slots + per-tile residuals) must
+      fit the shard's ``emits_per_block`` KV capacity — the shuffle
+      step's capacity contract is that the local combiner's output size
+      equals the raw emit count, and the kernel's output pads up to it.
+    """
+    ok, why = fused_engine_eligible(cfg, map_fn, combine)
+    if not ok:
+        return False, why
+    if jax.default_backend() != "tpu":
+        return False, (
+            "mesh fused mode is TPU-only (the interpret kernel never "
+            "runs inside a CPU mesh program — check_vma segfault class); "
+            "folding exactly like 'hasht'"
+        )
+    t_hi, t_lo = fused_table_layout()
+    n_tiles = cfg.block_lines // FUSED_TILE_LINES
+    preagg_rows = t_hi * t_lo + n_tiles * FUSED_RESIDUAL_ROWS
+    if preagg_rows > cfg.emits_per_block:
+        return False, (
+            f"kernel output ({preagg_rows} table+residual rows) exceeds "
+            f"the shard's emit capacity ({cfg.emits_per_block}); folding "
+            "exactly like 'hasht'"
+        )
+    return True, ""
 
 
 @functools.partial(

@@ -126,6 +126,14 @@ class HierarchicalMapReduce:
         both = (slice_axis, data_axis)
 
         norm_map_fn, norm_combine = normalize_combine(map_fn, combine)
+        # sort_mode="fused" (megakernel v2): per-shard Pallas kernel when
+        # eligible, explicit logged demotion (fused_demoted on results)
+        # otherwise — same gate as the flat engine (shuffle.py).
+        from locust_tpu.parallel.shuffle import _fused_mesh_gate
+
+        self._fused_kernel_on, self.fused_demoted = _fused_mesh_gate(
+            cfg, map_fn, combine, engine="hierarchical"
+        )
         local_step = build_shuffle_step(
             cfg,
             norm_map_fn,
@@ -137,6 +145,7 @@ class HierarchicalMapReduce:
             max_drains=self.max_drain_rounds,
             shuffle_axis=data_axis,     # the ICI-only shuffle
             stat_axes=(data_axis,),     # stats stay intra-slice per round
+            fused_preagg=self._fused_kernel_on,
         )
 
         def combine_step(acc: KVBatch):
@@ -188,9 +197,15 @@ class HierarchicalMapReduce:
                 mesh=mesh,
                 in_specs=(P(both), kv_spec_2d, kv_spec_2d),
                 out_specs=(kv_spec_2d, kv_spec_2d, P(slice_axis)),
+                # fused kernel engaged implies TPU (fused_mesh_eligible),
+                # so like the flat engine the check is only dropped on
+                # TPU — CPU mesh programs never trace a Pallas kernel.
                 check_vma=not (
-                    cfg.sort_mode == "bitonic"
-                    and jax.default_backend() == "tpu"
+                    (
+                        cfg.sort_mode == "bitonic"
+                        and jax.default_backend() == "tpu"
+                    )
+                    or self._fused_kernel_on
                 ),
             )
         )
@@ -498,4 +513,6 @@ class HierarchicalMapReduce:
             combine=self.combine,
             drain_rounds=drains_used,
             truncated=truncated,
+            fused_kernel="mesh" if self._fused_kernel_on else None,
+            fused_demoted=self.fused_demoted,
         )

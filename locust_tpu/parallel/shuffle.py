@@ -515,6 +515,7 @@ def build_shuffle_step(
     max_drains: int,
     shuffle_axis: str,
     stat_axes,
+    fused_preagg: bool = False,
 ):
     """The per-device feed+drain body shared by the flat and hierarchical
     engines (one copy, so the drain/stats protocol cannot diverge).
@@ -536,6 +537,20 @@ def build_shuffle_step(
     pair (reduce_stage.normalize_combine): the shard carry and merge here
     re-apply ``combine`` across levels, which is only correct for
     associative combiners.
+
+    ``fused_preagg`` (megakernel v2 mesh-native mode): replace
+    map_fn + local combiner with ONE Pallas fused_block_preagg launch per
+    shard — tokenize, dedupe, and pre-aggregate the shard's lines in VMEM
+    so the [lines, emits, key_width] token tensor never touches HBM.  The
+    caller gates this on :func:`fused_mesh_eligible` (TPU-only: the
+    interpret kernel never runs inside a CPU mesh program — the check_vma
+    segfault class, CLAUDE.md) and must disable check_vma on the wrapping
+    shard_map (the bitonic precedent: jax's vma machinery breaks inside
+    the Pallas re-trace).  The kernel output pads up to the local
+    combiner's capacity contract (output size == raw emit count) and a
+    residual overflow re-folds the shard's block through the stock path
+    via lax.cond — bit-identity to "hasht" carries over shard-by-shard
+    (the settlement argument, ops/pallas/fused_fold.py docstring).
     """
     n_lanes = cfg.key_lanes
 
@@ -597,7 +612,6 @@ def build_shuffle_step(
         whole feed-plus-drain one device dispatch; the host only syncs
         stats every ``stats_sync_every`` rounds.
         """
-        kv, emit_ovf = map_fn(lines, cfg)
         # Local combiner: same capacity contract either way (output size ==
         # kv.size, the shape partition_to_bins was sized for); partition is
         # order-agnostic, so neither hasht's slot-ordered table nor the
@@ -609,6 +623,48 @@ def build_shuffle_step(
         # compaction, full win kept on duplicate-heavy (WordCount-like)
         # blocks.  "hasht-mxu" carries its combine-scatter spelling into
         # the combiner's probe rounds too (scatter_impl_for).
+        if fused_preagg:
+            # Mesh-native megakernel (v2): ONE Pallas launch does
+            # tokenize + dedupe + pre-aggregate for this shard's lines;
+            # the kernel table + residual ARE the local combiner output
+            # (every destination re-reduces, so per-tile residual
+            # duplicates merge downstream exactly like any duplicate
+            # key rows).  interpret=False unconditionally: the caller's
+            # eligibility gate guarantees a TPU backend here.
+            from locust_tpu.ops.pallas.fused_fold import (
+                fused_block_preagg,
+            )
+
+            ktab, kresid, emit_ovf, bad = fused_block_preagg(
+                lines, cfg, interpret=False
+            )
+            pre = KVBatch.concat(ktab, kresid)
+            cap = lines.shape[0] * cfg.emits_per_line
+            fused_table = KVBatch.concat(
+                pre, KVBatch.empty(cap - pre.size, n_lanes)
+            )
+
+            def stock_table(_):
+                from locust_tpu.ops.hash_table import (
+                    combine_or_passthrough,
+                    scatter_impl_for,
+                )
+
+                kv, _ovf = map_fn(lines, cfg)  # same tokenize overflow
+                return combine_or_passthrough(
+                    kv, combine, probes=2,
+                    scatter_impl=scatter_impl_for(cfg.sort_mode),
+                )
+
+            # Residual overflow: re-fold this shard's block through the
+            # stock path — exact either way, and the overflow counter is
+            # the kernel's under both branches (identical tokenize
+            # formulation, fused_block_preagg docstring).
+            local_table = jax.lax.cond(
+                bad, stock_table, lambda _: fused_table, 0
+            )
+            return _shuffle_and_drain(local_table, emit_ovf, acc, leftover)
+        kv, emit_ovf = map_fn(lines, cfg)
         if cfg.sort_mode in HASHT_FAMILY:
             from locust_tpu.ops.hash_table import (
                 combine_or_passthrough,
@@ -621,6 +677,14 @@ def build_shuffle_step(
             )
         else:
             local_table = reduce_into(kv, kv.size, combine, cfg.sort_mode)[0]
+        return _shuffle_and_drain(local_table, emit_ovf, acc, leftover)
+
+    def _shuffle_and_drain(
+        local_table: KVBatch, emit_ovf, acc: KVBatch, leftover: KVBatch
+    ):
+        """The step's combiner-independent tail: feed the local table
+        into the shuffle, drain the backlog on device, stack stats —
+        one copy shared by the stock and fused-preagg combiner paths."""
         acc, leftover, shuf_ovf, distinct, backlog = shuffle_round(
             local_table, acc, leftover
         )
@@ -678,6 +742,29 @@ def merge_stats_vectors(a, b):
          jnp.maximum(a[:, 4], b[:, 4]), a[:, 5] + b[:, 5]],
         axis=1,
     ).reshape(-1)
+
+
+def _fused_mesh_gate(
+    cfg: EngineConfig, map_fn, combine: str, engine: str
+) -> tuple[bool, bool]:
+    """Shared fused-mode construction gate for the mesh engines.
+
+    Returns ``(kernel_on, demoted)``; logs the demotion ONCE at
+    construction — outside any traced code — naming the engine and the
+    reason, so operators can tell which kernel will serve their jobs
+    (ISSUE 19: the fused->hasht fallback used to be silent).
+    """
+    if cfg.sort_mode != "fused":
+        return False, False
+    from locust_tpu.ops.pallas.fused_fold import fused_mesh_eligible
+
+    ok, why = fused_mesh_eligible(cfg, map_fn, combine)
+    if not ok:
+        logger.info(
+            "%s mesh sort_mode='fused': kernel not engaged — %s "
+            "(results carry fused_demoted=True)", engine, why,
+        )
+    return ok, not ok
 
 
 class DistributedMapReduce:
@@ -766,6 +853,15 @@ class DistributedMapReduce:
         self._norm_map_name = getattr(
             norm_map_fn, "__name__", str(norm_map_fn)
         )
+        # sort_mode="fused" on the mesh (megakernel v2): run the Pallas
+        # kernel per shard under shard_map when eligible; otherwise fold
+        # as plain hasht with an EXPLICIT demotion — one construction
+        # log + fused_demoted on every result (ISSUE 19 bugfix: the
+        # fallback used to be silent).  Eligibility identifies the RAW
+        # map_fn + user combine, like the single-device engine.
+        self._fused_kernel_on, self.fused_demoted = _fused_mesh_gate(
+            cfg, map_fn, combine, engine="flat"
+        )
         local_step = build_shuffle_step(
             cfg,
             norm_map_fn,
@@ -777,6 +873,7 @@ class DistributedMapReduce:
             max_drains=self.max_drain_rounds,
             shuffle_axis=axis,
             stat_axes=(axis,),
+            fused_preagg=self._fused_kernel_on,
         )
 
         kv_spec = KVBatch(key_lanes=P(axis), values=P(axis), valid=P(axis))
@@ -809,9 +906,16 @@ class DistributedMapReduce:
                 mesh=mesh,
                 in_specs=(P(axis), kv_spec, kv_spec),
                 out_specs=(kv_spec, kv_spec, P()),
+                # fused kernel engaged implies a TPU backend
+                # (fused_mesh_eligible), so the check is only ever
+                # dropped on TPU — the CPU engines keep check_vma=True
+                # and never trace a Pallas kernel in a mesh program.
                 check_vma=not (
-                    cfg.sort_mode == "bitonic"
-                    and jax.default_backend() == "tpu"
+                    (
+                        cfg.sort_mode == "bitonic"
+                        and jax.default_backend() == "tpu"
+                    )
+                    or self._fused_kernel_on
                 ),
             )
         )
@@ -1049,6 +1153,8 @@ class DistributedMapReduce:
             combine=self.combine,
             drain_rounds=drains_used,
             truncated=truncated,
+            fused_kernel="mesh" if self._fused_kernel_on else None,
+            fused_demoted=self.fused_demoted,
         )
 
 
@@ -1091,6 +1197,8 @@ class DistributedResult:
         combine: str = "sum",
         drain_rounds: int = 0,
         truncated: bool = False,
+        fused_kernel: str | None = None,
+        fused_demoted: bool = False,
     ):
         self.table = table
         self.emit_overflow = emit_overflow    # tokens beyond the per-line cap
@@ -1099,6 +1207,13 @@ class DistributedResult:
         self.combine = combine
         self.drain_rounds = drain_rounds      # extra all-to-all rounds used
         self.truncated = truncated            # a shard's table overflowed
+        # Megakernel v2 visibility (mirror of RunResult.fused_kernel /
+        # .fused_demoted): "mesh" when the Pallas kernel served the
+        # per-shard combiner; fused_demoted=True when sort_mode="fused"
+        # was requested but the engine folded as plain hasht (off-TPU /
+        # ineligible shape) — previously invisible.
+        self.fused_kernel = fused_kernel
+        self.fused_demoted = fused_demoted
 
     def to_host_pairs(self, sort: bool = True) -> list[tuple[bytes, int]]:
         """Gather all shards; optionally re-sort to global key order.

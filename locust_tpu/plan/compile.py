@@ -592,6 +592,14 @@ class _RunCtx:
                 from locust_tpu.parallel.mesh import make_mesh
                 from locust_tpu.parallel.shuffle import DistributedMapReduce
 
+                opt = self.cp.optimized
+                if opt is not None and opt.fuse_kernel:
+                    # fuse_fold_kernel fires for mesh jobs too
+                    # (megakernel v2): the mesh engine's own
+                    # fused_mesh_eligible gate keeps runtime authority —
+                    # off-TPU it demotes explicitly (fused_demoted) and
+                    # folds exactly like hasht.
+                    cfg = dataclasses.replace(cfg, sort_mode="fused")
                 res = DistributedMapReduce(make_mesh(), cfg).run(rows)
                 pairs = res.to_host_pairs() if self.finalize else None
                 self._acct[sid] = (

@@ -97,9 +97,13 @@ def _fuse_fold_kernel(plan: Plan, cfg, mesh: bool, applied: list) -> bool:
     and because the engine's own eligibility check stays the runtime
     authority: off supported shapes/backends it degrades to plain hasht,
     byte-identically.  Static only — this module never probes a backend
-    (the jax-free contract), and it never fires under ``mesh`` (the
-    kernel has no mesh lowering yet, ROADMAP item 5)."""
-    if mesh or cfg is None or getattr(cfg, "sort_mode", None) != "hasht":
+    (the jax-free contract).  Fires for mesh and streaming jobs too
+    (megakernel v2): the mesh engines gate through
+    ``fused_mesh_eligible`` at construction and demote EXPLICITLY
+    (``fused_demoted``) when the kernel can't engage, and ``run_stream``
+    under ``sort_mode="fused"`` takes the persistent streaming
+    formulation — both still bit-identical to hasht."""
+    if cfg is None or getattr(cfg, "sort_mode", None) != "hasht":
         return False
     by_id = plan.by_id()
     for n in plan.nodes:

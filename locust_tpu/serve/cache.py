@@ -183,6 +183,20 @@ class ExecutableCache:
 
     def stats(self) -> dict:
         with self._lock:
+            # Megakernel visibility: how many warm engines actually run
+            # the fused kernel vs were demoted at construction (stats is
+            # where an operator finds out a fused-mode daemon is
+            # silently folding like hasht — the engines log the reason
+            # once, this keeps it visible after the log rotates).  Plan
+            # executables hold their engine as ``_engine`` (None until
+            # the first fold builds it).
+            fused_on = fused_demoted = 0
+            for eng in self._engines.values():
+                e = getattr(eng, "_engine", eng)
+                if getattr(e, "_fused_kernel_on", False):
+                    fused_on += 1
+                if getattr(e, "_fused_demoted", False):
+                    fused_demoted += 1
             return {
                 "engines": len(self._engines),
                 "shapes": len(self._shapes),
@@ -191,6 +205,8 @@ class ExecutableCache:
                 "builds": self.builds,
                 "compiles": self.compiles,
                 "evictions": self.evictions,
+                "fused_on": fused_on,
+                "fused_demoted": fused_demoted,
             }
 
 

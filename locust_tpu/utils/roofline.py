@@ -159,6 +159,8 @@ def pipeline_sort_traffic(
     n_blocks: int,
     block_lines: int | None = None,
     line_width: int | None = None,
+    fused_variant: str = "batch",
+    stream_seg_blocks: int | None = None,
 ) -> dict:
     """Estimated HBM bytes the fold's sorts move end-to-end.
 
@@ -173,8 +175,32 @@ def pipeline_sort_traffic(
     plus the hasht settlement sweeps over ``table_size + kernel slots +
     residual rows`` — the emit-count term disappears entirely, which is
     the mode's whole thesis.
+
+    ``fused_variant`` selects the megakernel v2 formulation:
+
+    * ``"batch"`` (default) — the v1 per-block model above: every block
+      pays the full table flush+decode AND the acc->settle->acc sweeps.
+    * ``"stream"`` — engine._run_stream_fused: the table stays
+      VMEM-resident across a SEGMENT of ``stream_seg_blocks`` blocks
+      (default: the SAME clamp the engine runs with,
+      config.fused_stream_seg_blocks on a TPU backend), so the flush +
+      settlement are paid once per SEGMENT; line reads and the bounded
+      residual stream stay per-tile.  Strictly below the batch figure
+      whenever the clamp exceeds one block (test-pinned at the bench
+      shape, the PR 13 strictly-below discipline).
+    * ``"mesh"`` — the per-shard shard_map formulation: the kernel
+      replaces map + the local combiner; the shuffle partition,
+      all-to-all and shard merge are unchanged by the mode and are NOT
+      modeled (they cancel in any fused-vs-hasht mesh comparison).
+      Charged per shard-block: the kernel's bytes plus the
+      combine-replacement sweeps over the pre-aggregated rows.
     """
     if sort_mode == "fused":
+        if fused_variant not in ("batch", "stream", "mesh"):
+            raise ValueError(
+                f"fused_variant must be batch/stream/mesh, "
+                f"got {fused_variant!r}"
+            )
         if block_lines is None or line_width is None:
             raise ValueError(
                 "fused roofline needs block_lines and line_width (the "
@@ -195,26 +221,77 @@ def pipeline_sort_traffic(
         n_tiles = -(-block_lines // FUSED_TILE_LINES)
         key_w = 4 * key_lanes
         resid_rows = n_tiles * FUSED_RESIDUAL_ROWS
-        kernel_bytes = (
-            block_lines * line_width                      # line block read
-            + 2 * (key_w + 2) * t_hi * t_lo * 4           # table flush+decode
-            + 2 * resid_rows * (key_w + FUSED_RESID_PAD) * 4  # residual
-        )
-        settle_rows = table_size + t_hi * t_lo + resid_rows
-        passes = sort_pass_count(settle_rows, "fused")
+        # Per-tile terms (paid for every line tile in every variant):
+        # the streaming line read + the bounded residual store+reload.
+        line_bytes = block_lines * line_width
+        resid_bytes = 2 * resid_rows * (key_w + FUSED_RESID_PAD) * 4
+        # Per-LAUNCH terms: the VMEM-resident table's flush + decode.
+        flush_bytes = 2 * (key_w + 2) * t_hi * t_lo * 4
         per_pass, gather = mode_row_bytes("hasht", key_lanes)
-        per_block = kernel_bytes + settle_rows * (
-            2 * per_pass * passes + gather
-        )
-        return {
+        out = {
             "sort_mode": sort_mode,
-            "rows_per_sort": settle_rows,
-            "sort_passes": passes,
             "n_blocks": n_blocks,
             "fused_grid": [t_hi, t_lo],
-            "est_kernel_bytes": int(n_blocks * kernel_bytes),
-            "est_sort_traffic_bytes": int(n_blocks * per_block),
+            "fused_variant": fused_variant,
         }
+        if fused_variant == "stream":
+            # The persistent streaming formulation: one launch + one
+            # settlement per SEGMENT; flush and acc sweeps amortize by
+            # the segment length.  The default segment is the SAME
+            # validated clamp the engine runs with (config — modeled
+            # for the TPU target, where the interpret cap is inactive).
+            if stream_seg_blocks is None:
+                from locust_tpu.config import fused_stream_seg_blocks
+
+                stream_seg_blocks = fused_stream_seg_blocks(
+                    emits_per_block, block_lines, on_tpu=True
+                )
+            seg = max(1, int(stream_seg_blocks))
+            n_segments = -(-n_blocks // seg)
+            seg_resid_rows = seg * resid_rows
+            settle_rows = table_size + t_hi * t_lo + seg_resid_rows
+            passes = sort_pass_count(settle_rows, "fused")
+            per_segment = (
+                seg * (line_bytes + resid_bytes)
+                + flush_bytes
+                + settle_rows * (2 * per_pass * passes + gather)
+            )
+            out.update(
+                rows_per_sort=settle_rows,
+                sort_passes=passes,
+                stream_seg_blocks=seg,
+                n_segments=n_segments,
+                est_kernel_bytes=int(
+                    n_segments * (seg * (line_bytes + resid_bytes)
+                                  + flush_bytes)
+                ),
+                est_sort_traffic_bytes=int(n_segments * per_segment),
+            )
+            return out
+        kernel_bytes = line_bytes + flush_bytes + resid_bytes
+        if fused_variant == "mesh":
+            # Per shard-block: kernel bytes + the local-combine-
+            # replacement sweeps over the pre-aggregated rows (shuffle /
+            # shard merge unchanged by the mode, not modeled).
+            preagg_rows = t_hi * t_lo + resid_rows
+            passes = sort_pass_count(preagg_rows, "fused")
+            per_block = kernel_bytes + preagg_rows * (
+                2 * per_pass * passes + gather
+            )
+        else:  # "batch" — the v1 per-block acc->settle->acc model
+            settle_rows = table_size + t_hi * t_lo + resid_rows
+            preagg_rows = settle_rows
+            passes = sort_pass_count(settle_rows, "fused")
+            per_block = kernel_bytes + settle_rows * (
+                2 * per_pass * passes + gather
+            )
+        out.update(
+            rows_per_sort=preagg_rows,
+            sort_passes=passes,
+            est_kernel_bytes=int(n_blocks * kernel_bytes),
+            est_sort_traffic_bytes=int(n_blocks * per_block),
+        )
+        return out
     per_pass, gather = mode_row_bytes(sort_mode, key_lanes)
     n_rows = table_size + emits_per_block
     passes = sort_pass_count(n_rows, sort_mode)
@@ -263,11 +340,14 @@ def summarize(
     device_kind: str | None,
     block_lines: int | None = None,
     line_width: int | None = None,
+    fused_variant: str = "batch",
+    stream_seg_blocks: int | None = None,
 ) -> dict:
     """The bench-facing roofline row: traffic model + achieved vs peak."""
     out = pipeline_sort_traffic(
         sort_mode, key_lanes, emits_per_block, table_size, n_blocks,
         block_lines=block_lines, line_width=line_width,
+        fused_variant=fused_variant, stream_seg_blocks=stream_seg_blocks,
     )
     gb = out["est_sort_traffic_bytes"] / 1e9
     achieved = gb / elapsed_s if elapsed_s > 0 else 0.0

@@ -158,6 +158,17 @@ def main(argv=None) -> int:
         timeout=420,
     ).returncode
 
+    # Fused-stream smoke (docs/PERF.md "Megakernel v2"): the persistent
+    # STREAMING formulation of the map->aggregate megakernel — a
+    # `--stream --sort-mode fused` CLI run over 20 blocks (3 segments,
+    # the last partial) must be byte-identical to the one-shot hasht
+    # CLI, and the stream stats must show the streaming formulation
+    # actually engaged (not a demotion).  Same pinned env.
+    fused_stream_rc = subprocess.run(
+        [sys.executable, "-c", _FUSED_STREAM_SMOKE], cwd=REPO, env=env,
+        timeout=300,
+    ).returncode
+
     # Machine-death failover smoke (docs/SERVING.md "High
     # availability"): a REAL primary+standby pair, the primary
     # SIGKILL'd holding a wordcount AND a journaled plan job, the
@@ -173,12 +184,13 @@ def main(argv=None) -> int:
         f"trace round-trip rc={trace_rc}; serve smoke rc={serve_rc}; "
         f"recovery smoke rc={recovery_rc}; pool smoke rc={pool_rc}; "
         f"plan smoke rc={plan_rc}; dplan smoke rc={dplan_rc}; "
+        f"fused-stream smoke rc={fused_stream_rc}; "
         f"failover smoke rc={failover_rc}",
         file=sys.stderr,
     )
     return (rc or proc.returncode or trace_rc or serve_rc
             or recovery_rc or pool_rc or plan_rc or dplan_rc
-            or failover_rc)
+            or fused_stream_rc or failover_rc)
 
 
 _TRACE_ROUNDTRIP = """
@@ -646,6 +658,51 @@ finally:
 print("[check] dplan smoke ok (tfidf plan across 2 real workers; "
       "SIGKILL mid-map-stage -> survivor recompute, byte-identical "
       "to the one-shot CLI)", file=sys.stderr)
+"""
+
+
+_FUSED_STREAM_SMOKE = """
+import os, subprocess, sys, tempfile
+
+td = tempfile.mkdtemp(prefix="locust_fused_stream_smoke_")
+corpus_path = os.path.join(td, "corpus.txt")
+with open(corpus_path, "wb") as f:
+    f.write((b"alpha beta gamma\\nbeta gamma delta\\nalpha alpha\\n"
+             b"epsilon zeta\\n") * 160)   # 640 lines = 20 blocks of 32
+cfg_flags = ["--block-lines", "32", "--line-width", "128",
+             "--key-width", "16", "--emits-per-line", "8"]
+env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": os.getcwd()}
+
+# The oracle: the one-shot hasht CLI over the same corpus + caps.
+one_shot = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", corpus_path,
+     "--backend", "cpu", "--no-timing", "--sort-mode", "hasht"]
+    + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert one_shot.returncode == 0, one_shot.stderr[-800:]
+
+# The persistent streaming kernel: `--stream --sort-mode fused` folds
+# 8-block segment buffers inside one kernel dispatch each (megakernel
+# v2, docs/PERF.md) — 20 blocks = 3 segments, the last PARTIAL, so the
+# zero-pad path is inside the identity, not just the aligned case.
+fused = subprocess.run(
+    [sys.executable, "-m", "locust_tpu", corpus_path,
+     "--backend", "cpu", "--no-timing", "--stream",
+     "--sort-mode", "fused"] + cfg_flags,
+    env=env, capture_output=True, timeout=240,
+)
+assert fused.returncode == 0, fused.stderr[-800:]
+assert fused.stdout == one_shot.stdout, (
+    "streamed fused run != one-shot hasht CLI\\n%r\\n%r"
+    % (fused.stdout[:200], one_shot.stdout[:200])
+)
+# The run must have taken the streaming FORMULATION, not a demotion:
+# run_stream surfaces it in the `[locust] stream:` stats line.
+assert b"'formulation': 'stream'" in fused.stderr, fused.stderr[-800:]
+print("[check] fused-stream smoke ok (persistent streaming kernel, "
+      "3 segments incl. a partial, byte-identical to the one-shot "
+      "hasht CLI)", file=sys.stderr)
 """
 
 

@@ -51,6 +51,13 @@ AB_SORT_MODES = ("hasht", "fused", "hasht-mxu", "hashp2", "hashp1", "hashp",
 # engine_sort_mode_ab rows, so phase 3 resumes past them for free).
 FUSED_AB_MODES = ("hasht", "fused", "hasht-mxu")
 
+# The second-slot streaming verdict (megakernel v2): the persistent
+# streaming kernel vs plain hasht through run_stream.  Distinct mode
+# labels so the rows share the engine_sort_mode_ab shape (and
+# _prior_mode_results' resume) without ever colliding with the batch
+# modes above.
+FUSED_STREAM_AB_MODES = ("fused_stream", "hasht_stream")
+
 # Engines memoized by their frozen EngineConfig: several phases measure
 # the SAME winning configuration (block A/B winner -> pallas False side
 # -> profiler capture -> bench-shape stage breakdown), and a fresh
@@ -505,6 +512,85 @@ def phase_fused_ab(rows_ab, corpus_bytes, caps=None) -> str:
     land."""
     return phase_sort_mode_ab(rows_ab, corpus_bytes, caps=caps,
                               modes=FUSED_AB_MODES)
+
+
+def phase_fused_stream_ab(rows_ab, corpus_bytes, caps=None) -> None:
+    """Second-window-slot streaming verdict (megakernel v2): the
+    persistent streaming kernel (``sort_mode="fused"`` through
+    ``run_stream`` — the table stays VMEM-resident across a whole
+    segment of blocks, settled once per segment) vs plain hasht
+    streaming over the SAME block stream.  Ordinary
+    ``engine_sort_mode_ab`` rows under the ``fused_stream`` /
+    ``hasht_stream`` mode labels, so ``_prior_mode_results`` resumes a
+    window that died after one side — nothing is measured twice and the
+    row shape every evidence reader already parses carries the
+    streaming numbers too.  Block count is bounded
+    (``LOCUST_OPP_STREAM_AB_BLOCKS``): per-block dispatch rides the
+    tunnel and a full 32MB stream must not eat the window."""
+    import bench
+
+    from locust_tpu.utils import artifacts
+
+    corpus_mb = round(corpus_bytes / 1e6, 1)
+    results = {
+        m: r for m, r in _prior_mode_results(corpus_mb, caps).items()
+        if m in FUSED_STREAM_AB_MODES
+    }
+    if results:
+        print(f"[opp] fused-stream A/B resuming; already measured this "
+              f"session: {sorted(results)}", file=sys.stderr)
+    max_blocks = int(os.environ.get("LOCUST_OPP_STREAM_AB_BLOCKS", 24))
+    for label in FUSED_STREAM_AB_MODES:
+        if label in results:
+            continue
+        sort_mode = "fused" if label == "fused_stream" else "hasht"
+        try:
+            eng = get_engine(
+                bench.bench_engine_config(32768, sort_mode=sort_mode,
+                                          **(caps or {}))
+            )
+            bl = eng.cfg.block_lines
+            n = min(rows_ab.shape[0], max_blocks * bl)
+            streamed_bytes = corpus_bytes * n / max(1, rows_ab.shape[0])
+
+            def blocks():
+                for i in range(0, n, bl):
+                    yield rows_ab[i:i + bl]
+
+            t0 = time.perf_counter()
+            res = eng.run_stream(blocks())  # compile + warm
+            compile_s = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                res = eng.run_stream(blocks())
+                best = min(best, time.perf_counter() - t0)
+            results[label] = {
+                "mb_s": round(streamed_bytes / 1e6 / best, 2),
+                "best_s": round(best, 4),
+                "compile_s": round(compile_s, 1),
+                "blocks": -(-n // bl),
+                "distinct": res.num_segments,
+                "overflow_tokens": res.overflow_tokens,
+                # Which formulation actually ran — "stream" is the
+                # claim under test; None + demoted=True means the gate
+                # turned the kernel off and this side IS hasht.
+                "formulation": res.fused_kernel,
+                "fused_demoted": bool(res.fused_demoted),
+            }
+        except Exception as e:  # noqa: BLE001 - one side must not cost the
+            # window the other side's row; an errored side has no mb_s
+            # and is re-attempted next window.
+            results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"[opp] mode={label}: {results[label]}", file=sys.stderr)
+        artifacts.record(
+            "engine_sort_mode_ab",
+            {"corpus_mb": corpus_mb, "caps": caps,
+             "modes": dict(results),
+             "partial": any(
+                 m not in results for m in FUSED_STREAM_AB_MODES
+             )},
+        )
 
 
 def phase_sort_mode_ab(rows_ab, corpus_bytes, caps=None, modes=None) -> str:

@@ -10,6 +10,9 @@ skipped, see _session_row_ok):
   0. fused_ab: engine-level fused-megakernel vs hasht vs hasht-mxu rows
      (ordinary engine_sort_mode_ab rows, carried into phase 2's resume)
      — the first slot, before any compile-heavy phase can eat the window
+  0.5. fused_stream_ab: the persistent STREAMING kernel vs hasht through
+     run_stream (megakernel v2) — fused_stream/hasht_stream rows in the
+     same engine_sort_mode_ab shape, right behind the batch verdict
   1. sort-variant bench at the engine's true Process-stage shape —
      only the PRODUCTIVE variants this session hasn't measured yet (the
      Pallas bitonic variant H is demoted to phase 3)
@@ -138,6 +141,18 @@ def main() -> int:
         opp_resume._guard(
             "fused_ab",
             lambda: opp_resume.phase_fused_ab(
+                rows_ab, corpus_bytes,
+                caps={"key_width": kw, "emits_per_line": epl},
+            ),
+        )
+        # Phase 0.5 (megakernel v2): the persistent STREAMING kernel's
+        # verdict — fused_stream vs hasht_stream run_stream rows,
+        # immediately after the batch fused verdict and still before
+        # any compile-heavy phase.  Same engine_sort_mode_ab row shape,
+        # so a window that dies after one side resumes past it.
+        opp_resume._guard(
+            "fused_stream_ab",
+            lambda: opp_resume.phase_fused_stream_ab(
                 rows_ab, corpus_bytes,
                 caps={"key_width": kw, "emits_per_line": epl},
             ),

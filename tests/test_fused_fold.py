@@ -645,3 +645,290 @@ def test_roofline_fused_requires_block_geometry():
         block_lines=32768, line_width=128,
     )
     assert out["est_sort_traffic_bytes"] > 0
+
+
+# ------------------------------------------------ megakernel v2: stream
+
+
+def _stream_cfg(**kw):
+    kw.setdefault("block_lines", 64)
+    kw.setdefault("line_width", 128)
+    kw.setdefault("key_width", 8)
+    kw.setdefault("emits_per_line", 8)
+    kw.setdefault("sort_mode", "fused")
+    return EngineConfig(**kw)
+
+
+def test_fused_stream_seg_blocks_clamps():
+    """The segment-size clamp (config.fused_stream_seg_blocks): the
+    exactness bound (segment emits < 2^24 for the f32 count planes), the
+    off-TPU interpret cap (segment lines <= FUSED_INTERPRET_MAX_LINES —
+    the interpreter re-traces per grid step), and the >=1 floor."""
+    from locust_tpu.config import (
+        FUSED_INTERPRET_MAX_LINES,
+        FUSED_STREAM_BLOCKS,
+        fused_stream_seg_blocks,
+    )
+
+    # Small shapes: the configured default survives intact on TPU.
+    assert fused_stream_seg_blocks(512, 64, True) == FUSED_STREAM_BLOCKS
+    # Exactness cap: emits_per_block so large only 1 block fits 2^24.
+    assert fused_stream_seg_blocks((1 << 24) - 1, 64, True) == 1
+    assert fused_stream_seg_blocks(1 << 23, 64, True) == 1
+    # Off-TPU interpret cap: block_lines at the interpret max -> seg 1.
+    assert fused_stream_seg_blocks(512, FUSED_INTERPRET_MAX_LINES, False) == 1
+    # Off-TPU small blocks keep the default (the cap is generous).
+    assert fused_stream_seg_blocks(512, 64, False) == FUSED_STREAM_BLOCKS
+    # The floor: never 0, whatever the shape.
+    assert fused_stream_seg_blocks(1 << 30, 1 << 20, False) == 1
+
+
+def test_stream_fused_multi_segment_identical_to_hasht():
+    """The persistent streaming kernel across FULL and PARTIAL segments
+    must be BIT-identical to hasht streaming over the same blocks — the
+    v2 acceptance bar.  20 blocks at seg=8 exercises two full segments
+    plus a 4-block trailing partial (zero-padded, the _blocks padding
+    contract)."""
+    lines = corpus_lines(600)
+    f_eng = MapReduceEngine(_stream_cfg(block_lines=32))
+    h_eng = MapReduceEngine(_stream_cfg(block_lines=32, sort_mode="hasht"))
+    assert f_eng._fold_segment is not None  # streaming formulation armed
+    bl = f_eng.cfg.block_lines
+
+    def blocks(eng):
+        rows = eng.rows_from_lines(lines)
+        for i in range(0, rows.shape[0], bl):
+            yield rows[i:i + bl]
+
+    f = f_eng.run_stream(blocks(f_eng))
+    h = h_eng.run_stream(blocks(h_eng))
+    _assert_tables_identical(f.table, h.table, "stream fused vs hasht")
+    assert f.num_segments == h.num_segments
+    assert f.overflow_tokens == h.overflow_tokens
+    assert dict(f.to_host_pairs()) == py_wordcount(lines, 8, 8)
+    # Result + stats surface the formulation (no silent anything).
+    assert f.fused_kernel == "stream" and not f.fused_demoted
+    fs = f.stream["fused"]
+    assert fs["formulation"] == "stream" and fs["seg_blocks"] > 1
+    assert f.stream["blocks"] > fs["seg_blocks"]  # genuinely multi-segment
+    assert f.stream["blocks"] % fs["seg_blocks"] != 0  # partial trailing seg
+    assert fs["segments"] == -(-f.stream["blocks"] // fs["seg_blocks"])
+    assert h.fused_kernel is None and not h.fused_demoted
+
+
+def test_stream_fused_without_staging_ring_identical():
+    """cfg.stream_staging_ring=False takes the fresh-buffer path through
+    the same segment dispatch — identical tables either way."""
+    lines = corpus_lines(150)
+    a_eng = MapReduceEngine(_stream_cfg())
+    b_eng = MapReduceEngine(_stream_cfg(stream_staging_ring=False))
+    bl = a_eng.cfg.block_lines
+
+    def blocks(eng):
+        rows = eng.rows_from_lines(lines)
+        for i in range(0, rows.shape[0], bl):
+            yield rows[i:i + bl]
+
+    a = a_eng.run_stream(blocks(a_eng))
+    b = b_eng.run_stream(blocks(b_eng))
+    _assert_tables_identical(a.table, b.table, "ring vs alloc staging")
+    assert a.stream["staging_ring"] and not b.stream["staging_ring"]
+
+
+def test_stream_fused_crash_resume_byte_identical(tmp_path):
+    """Crash mid-stream under the persistent kernel, resume from the
+    snapshot: the restored table re-enters the resident kernel (the
+    _load_state copy feeds the donated segment fold) and the final
+    table is BIT-identical to hasht streaming the whole corpus — even
+    though the resume REGROUPS the remaining blocks into fresh segments
+    (the fold is a pure function of the line multiset)."""
+    from locust_tpu.io.loader import StreamingCorpus
+
+    lines = corpus_lines(600)  # 19 blocks at bl=32: 3 segments at seg=8
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"\n".join(lines) + b"\n")
+    cfg = _stream_cfg(block_lines=32)
+    sc = lambda: StreamingCorpus(str(p), cfg.line_width, cfg.block_lines)  # noqa: E731
+    want = MapReduceEngine(
+        _stream_cfg(block_lines=32, sort_mode="hasht")
+    ).run_stream(sc())
+
+    ckpt = str(tmp_path / "ckpt")
+    fp = sc().fingerprint()
+    eng = MapReduceEngine(cfg)
+    assert eng._fold_segment is not None
+    real_seg = eng._fold_segment
+    calls = {"n": 0}
+
+    def dying_segment(acc, seg_lines):
+        if calls["n"] >= 1:
+            raise RuntimeError("injected stream crash")
+        calls["n"] += 1
+        return real_seg(acc, seg_lines)
+
+    # every=3 with seg=8: the mark cadence is segment-granular, so the
+    # crash after one dispatched segment leaves a mid-stream snapshot.
+    eng._fold_segment = dying_segment
+    with pytest.raises(RuntimeError, match="injected stream crash"):
+        eng.run_stream(sc(), checkpoint_dir=ckpt, every=3, fingerprint=fp)
+    eng._fold_segment = real_seg
+    res = eng.run_stream(sc(), checkpoint_dir=ckpt, every=3, fingerprint=fp)
+    _assert_tables_identical(res.table, want.table, "crash-resume stream")
+    assert res.num_segments == want.num_segments
+    assert res.overflow_tokens == want.overflow_tokens
+    assert res.fused_kernel == "stream"
+    # A further resume on the finished snapshot folds nothing and still
+    # reports the restored table (the exhausted-iterator contract).
+    res2 = eng.run_stream(iter([]), checkpoint_dir=ckpt, every=3,
+                          fingerprint=fp)
+    _assert_tables_identical(res2.table, want.table, "no-op resume")
+
+
+def test_breaker_failover_with_streaming_kernel_active(tmp_path):
+    """Breaker trip + mid-job TPU->CPU failover on an engine whose
+    PERSISTENT STREAMING formulation is armed: the fallback dispatch
+    stays kernel-free (stock fold) and the table stays oracle-exact —
+    then the SAME engine's run_stream still takes the segment kernel
+    path, unpoisoned by the failover."""
+    from locust_tpu.backend import CircuitBreaker
+    from locust_tpu.utils import faultplan
+
+    cfg = _stream_cfg(block_lines=32, emits_per_line=6)
+    eng = MapReduceEngine(cfg)
+    assert eng._fused_kernel_on and eng._fold_segment is not None
+    lines = [b"aaa bbb ccc", b"bbb ccc ddd"] * 64  # 4 blocks
+    rows = eng.rows_from_lines(lines)
+    want = dict(eng.run(rows).to_host_pairs())
+
+    br = CircuitBreaker(threshold=2, cooldown_s=30.0)  # stays open
+    p = faultplan.FaultPlan(
+        [{"site": "backend.dispatch", "action": "error", "times": 3}],
+        seed=11,
+    )
+    with faultplan.active_plan(p):
+        res = eng.run_checkpointed(
+            rows, str(tmp_path / "ck"), every=1, breaker=br
+        )
+    assert dict(res.to_host_pairs()) == want
+    assert br.stats()["trips"] == 1
+    bl = cfg.block_lines
+    streamed = eng.run_stream(
+        rows[i:i + bl] for i in range(0, rows.shape[0], bl)
+    )
+    assert dict(streamed.to_host_pairs()) == want
+    assert streamed.fused_kernel == "stream"
+
+
+# -------------------------------------------- megakernel v2: mesh-native
+
+
+def test_fused_mesh_eligible_gates_backend_and_capacity(monkeypatch):
+    """fused_mesh_eligible: off-TPU is a hard no (the interpret kernel
+    never traces inside a CPU mesh program — the check_vma segfault
+    class), and on TPU the kernel's table+residual output must fit the
+    shard's emit capacity (the local combiner's fixed-size contract)."""
+    from locust_tpu.ops.pallas import fused_fold as ff
+
+    cfg = _stream_cfg(block_lines=32, emits_per_line=4)
+    ok, why = ff.fused_mesh_eligible(cfg, wordcount_map, "count")
+    assert not ok and "TPU-only" in why
+
+    monkeypatch.setattr(ff.jax, "default_backend", lambda: "tpu")
+    # emits_per_block (32*4=128) << table planes: capacity refusal.
+    ok, why = ff.fused_mesh_eligible(cfg, wordcount_map, "count")
+    assert not ok and "emit capacity" in why
+    # Enough emit capacity: eligible on (mocked) TPU.
+    big = _stream_cfg(block_lines=1024, emits_per_line=9)
+    ok, why = ff.fused_mesh_eligible(big, wordcount_map, "count")
+    assert ok, why
+    # Base ineligibility (non-wordcount spine) propagates unchanged.
+    ok, why = ff.fused_mesh_eligible(
+        big, lambda lines, cfg: None, "count"
+    )
+    assert not ok
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_mesh_fused_demotion_is_explicit_not_silent(caplog):
+    """The PR 13 silent demotion is gone: a CPU mesh engine under
+    sort_mode="fused" logs the reason ONCE at construction and the
+    result carries fused_demoted=True / fused_kernel=None — while a
+    hasht mesh engine reports neither."""
+    import logging
+
+    from locust_tpu.parallel import DistributedMapReduce, make_mesh
+    from locust_tpu.parallel.hierarchical import HierarchicalMapReduce
+    from locust_tpu.parallel.mesh import make_mesh_2d
+
+    lines = [ln[:64] for ln in corpus_lines(160)]
+    rows = bytes_ops.strings_to_rows(lines, 64)
+    with caplog.at_level(logging.INFO, logger="locust_tpu"):
+        dmr = DistributedMapReduce(
+            make_mesh(),
+            EngineConfig(block_lines=32, line_width=64, emits_per_line=12,
+                         sort_mode="fused"),
+        )
+    assert dmr.fused_demoted
+    assert sum(
+        "kernel not engaged" in r.message for r in caplog.records
+    ) == 1  # one-time construction log, engine named
+    res = dmr.run(rows)
+    assert res.fused_demoted and res.fused_kernel is None
+    assert res.to_host_pairs() == sorted(py_wordcount(lines, 12).items())
+
+    h = HierarchicalMapReduce(
+        make_mesh_2d(2),
+        EngineConfig(block_lines=16, line_width=64, emits_per_line=12,
+                     sort_mode="fused"),
+    )
+    assert h.fused_demoted
+    hres = h.run(rows)
+    assert hres.fused_demoted and hres.fused_kernel is None
+
+    hasht = DistributedMapReduce(
+        make_mesh(),
+        EngineConfig(block_lines=32, line_width=64, emits_per_line=12,
+                     sort_mode="hasht"),
+    )
+    assert not hasht.fused_demoted
+    hr = hasht.run(rows)
+    assert not hr.fused_demoted and hr.fused_kernel is None
+    assert res.to_host_pairs() == hr.to_host_pairs()
+
+
+def test_roofline_stream_strictly_below_batch_at_bench_shape():
+    """The v2 acceptance pin: at the bench shape the persistent
+    streaming kernel's modeled per-stream HBM bytes are STRICTLY below
+    v1's per-block (batch) figure — the acc->settle->acc round-trip and
+    the table flush amortize across the segment — and the mesh variant
+    prices below batch too (per-shard settlement over preagg rows)."""
+    from locust_tpu.utils import roofline
+
+    common = dict(key_lanes=4, emits_per_block=32768 * 17,
+                  table_size=65536, n_blocks=24,
+                  block_lines=32768, line_width=128)
+    batch = roofline.pipeline_sort_traffic("fused", **common)
+    stream = roofline.pipeline_sort_traffic(
+        "fused", fused_variant="stream", **common
+    )
+    mesh = roofline.pipeline_sort_traffic(
+        "fused", fused_variant="mesh", **common
+    )
+    assert stream["est_sort_traffic_bytes"] < batch["est_sort_traffic_bytes"]
+    assert mesh["est_sort_traffic_bytes"] < batch["est_sort_traffic_bytes"]
+    assert batch["fused_variant"] == "batch"
+    assert stream["fused_variant"] == "stream"
+    assert stream["stream_seg_blocks"] >= 1
+    assert stream["n_segments"] == -(-24 // stream["stream_seg_blocks"])
+    # The default segment size comes from the SAME clamp the engine
+    # uses (config.fused_stream_seg_blocks) — model and runtime can't
+    # drift.
+    from locust_tpu.config import fused_stream_seg_blocks
+
+    assert stream["stream_seg_blocks"] == fused_stream_seg_blocks(
+        32768 * 17, 32768, True
+    )
+    with pytest.raises(ValueError, match="fused_variant"):
+        roofline.pipeline_sort_traffic(
+            "fused", fused_variant="nope", **common
+        )

@@ -11,6 +11,7 @@ import dataclasses
 import hashlib
 import random
 
+import jax
 import numpy as np
 import pytest
 
@@ -128,13 +129,31 @@ def test_fuse_fold_kernel_under_hasht_is_byte_identical():
 
 
 def test_fuse_rule_is_static_and_scoped():
-    # Never under mesh (no mesh lowering), never without an explicit
-    # hasht config, and only on the tokenize_count fold spine — the
-    # optimizer stays jax-free and the ENGINE keeps runtime authority.
-    assert not optimize(wordcount_plan(), HASHT, mesh=True).fuse_kernel
+    # Megakernel v2: mesh jobs fuse too (the distributed engines gate
+    # through fused_mesh_eligible and demote explicitly off-TPU); never
+    # without an explicit hasht config, and only on the tokenize_count
+    # fold spine — the optimizer stays jax-free and the ENGINE keeps
+    # runtime authority.
+    assert optimize(wordcount_plan(), HASHT, mesh=True).fuse_kernel
     assert not optimize(wordcount_plan(), CFG).fuse_kernel
     assert not optimize(wordcount_plan()).fuse_kernel
     assert not optimize(tfidf_plan(2), HASHT).fuse_kernel
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_fuse_fold_kernel_mesh_is_byte_identical_and_demotes_explicitly():
+    """The mesh consumption of fuse_kernel (megakernel v2): the rewrite
+    renames the mesh fold onto sort_mode="fused", the distributed engine
+    demotes EXPLICITLY on CPU (the interpret kernel never runs inside a
+    CPU mesh program) and the sink bytes stay identical to the naive
+    hasht lowering."""
+    rows = _rows()
+    a = compile_plan(wordcount_plan(), HASHT, mesh=True).run(rows)
+    b = compile_plan(
+        wordcount_plan(), HASHT, mesh=True, optimize=False
+    ).run(rows)
+    assert a.output == b.output
+    assert a.value == b.value
 
 
 # -------------------------------------------------------- compose_score

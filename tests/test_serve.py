@@ -164,6 +164,7 @@ def test_exec_cache_hit_miss_and_fingerprint_invalidation():
     assert cache.stats() == {
         "engines": 1, "shapes": 1, "hits": 1, "misses": 1,
         "builds": 1, "compiles": 1, "evictions": 0,
+        "fused_on": 0, "fused_demoted": 0,
     }
     # An EngineConfig change invalidates the executable identity.
     spec2 = JobSpec(
@@ -173,6 +174,35 @@ def test_exec_cache_hit_miss_and_fingerprint_invalidation():
     assert spec2.fingerprint() != spec.fingerprint()
     _, hit3 = cache.lookup(spec2, 1, 1)
     assert not hit3 and cache.stats()["builds"] == 2
+
+
+def test_exec_cache_stats_surface_fused_kernel_state():
+    """Megakernel visibility on the warm-cache path: stats count the
+    warm engines actually running the fused kernel vs demoted at
+    construction — on CPU a fused spec at an interpret-eligible shape
+    shows fused_on, and one past the interpret cap shows
+    fused_demoted (the engine logs the reason; stats keep it visible)."""
+    cache = ExecutableCache(max_engines=4)
+    on = JobSpec(
+        tenant="t", workload="wordcount",
+        cfg=EngineConfig(
+            **dict(CFG_OVR, sort_mode="fused", block_lines=32,
+                   line_width=128)
+        ),
+    )
+    cache.lookup(on, 1, 1)
+    st = cache.stats()
+    assert st["fused_on"] == 1 and st["fused_demoted"] == 0
+    demoted = JobSpec(
+        tenant="t", workload="wordcount",
+        cfg=EngineConfig(
+            **dict(CFG_OVR, sort_mode="fused", block_lines=32768,
+                   line_width=128)
+        ),
+    )
+    cache.lookup(demoted, 1, 1)
+    st = cache.stats()
+    assert st["fused_on"] == 1 and st["fused_demoted"] == 1
 
 
 def test_exec_cache_shape_bucket_sharing():
