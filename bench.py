@@ -1240,26 +1240,62 @@ def _plan_distributed_scaling() -> dict:
     the honest raw ratio is ~1x or below — physics plus shuffle
     overhead, not a placement failure.  Identity is asserted IN-ROW:
     every measured run's bytes must equal the solo compiled plan's.
+
+    The v2 surface (ISSUE 20) adds ``join`` and ``pagerank`` rows —
+    a deep two-hop join tree and a 4-iteration pagerank through the
+    same 1-vs-2-lane lens — and a ``warm_repeat`` row pinning that a
+    repeat distributed submit rides the workers' warm plan-node
+    executables: per-worker compile counts unchanged across the
+    repeat, ``map_warm_hits`` > 0, asserted in-row.
     """
     import threading
 
     from locust_tpu.config import EngineConfig
     from locust_tpu.distributor.worker import Worker
     from locust_tpu.io.corpus import synthetic_corpus
-    from locust_tpu.plan import tfidf_plan
+    from locust_tpu.plan import pagerank_plan, tfidf_plan
     from locust_tpu.plan.compile import compile_plan
+    from locust_tpu.plan.nodes import Plan as PlanDoc, node
     from locust_tpu.serve.client import ServeClient
     from locust_tpu.serve.daemon import ServeConfig, ServeDaemon
 
     cfg_ovr = {"block_lines": 64, "line_width": 64, "key_width": 16,
                "emits_per_line": 8}
+    cfg = EngineConfig(**cfg_ovr)
     lines = synthetic_corpus(256 * 64, n_vocab=2000, seed=23,
                              words_per_line=6)
     corpus = b"\n".join(lines[:256]) + b"\n"
     plan = tfidf_plan(2)
-    oracle = compile_plan(
-        plan, EngineConfig(**cfg_ovr)
-    ).run_corpus(corpus).output
+    oracle = compile_plan(plan, cfg).run_corpus(corpus).output
+
+    # The v2 surface's workloads (ISSUE 20): a DEEP join tree (two join
+    # hops over three wordcount-fold leaves — the 3-stage pipeline
+    # shape) and an iterative pagerank.  The join corpus keeps its
+    # vocabulary small so the leaf folds provably fit the table (the
+    # distributed join refuses truncated leaves).
+    jnodes = []
+    for i in (1, 2, 3):
+        jnodes += [
+            node(f"c{i}", "source", "text"),
+            node(f"m{i}", "map", "tokenize_count", (f"c{i}",)),
+            node(f"s{i}", "shuffle", "by_key", (f"m{i}",)),
+            node(f"r{i}", "reduce", "sum", (f"s{i}",)),
+        ]
+    jnodes += [
+        node("j1", "join", "inner", ("r1", "r2"), combine="sum"),
+        node("j2", "join", "inner", ("j1", "r3"), combine="mul"),
+        node("out", "sink", "table", ("j2",)),
+    ]
+    join_plan = PlanDoc(tuple(jnodes))
+    jlines = synthetic_corpus(192 * 64, n_vocab=300, seed=7,
+                              words_per_line=6)
+    jcorpus = b"\n".join(jlines[:192]) + b"\n"
+    join_oracle = compile_plan(join_plan, cfg).run_corpus(
+        jcorpus).output
+
+    pr_plan = pagerank_plan(4)
+    edges = b"0 1\n1 2\n2 0\n0 2\n3 1\n2 3\n" * 64
+    pr_oracle = compile_plan(pr_plan, cfg).run_corpus(edges).output
 
     one_device = threading.Lock()
 
@@ -1280,7 +1316,17 @@ def _plan_distributed_scaling() -> dict:
                 time.sleep(_POOL_DEVICE_MS / 1e3)
             return super()._plan_stage(req)
 
-    def measure(worker_cls) -> float:
+    def measure(worker_cls, wl_plan=None, wl_corpus=None,
+                wl_oracle=None, repeat_probe=False):
+        """One daemon (+ two workers unless worker_cls is None), one
+        untimed warmup submit, one timed submit; byte-identity vs the
+        solo compiled plan asserted on EVERY run.  repeat_probe=True
+        also returns the warm-repeat evidence: per-worker compile
+        counts around the timed (repeat) submit and the pool's
+        map_warm_hits — the repeat must land on warm executables."""
+        wl_plan = plan if wl_plan is None else wl_plan
+        wl_corpus = corpus if wl_corpus is None else wl_corpus
+        wl_oracle = oracle if wl_oracle is None else wl_oracle
         ws = []
         daemon = None
         try:
@@ -1298,23 +1344,38 @@ def _plan_distributed_scaling() -> dict:
                                  timeout=120.0)
 
             def run_once() -> str:
-                ack = client.submit(corpus=corpus, config=cfg_ovr,
-                                    plan=plan.to_doc(), no_cache=True)
+                ack = client.submit(corpus=wl_corpus, config=cfg_ovr,
+                                    plan=wl_plan.to_doc(),
+                                    no_cache=True)
                 res = client.wait(ack["job_id"], timeout=600.0,
                                   poll_s=0.02)
-                assert res["pairs"][0][0] == oracle, (
+                assert res["pairs"][0][0] == wl_oracle, (
                     "distributed plan bytes diverged from the solo "
                     "compiled plan"
                 )
                 return client.status(ack["job_id"])["placed_on"]
 
             run_once()  # untimed warmup: compiles + connections
+            pre = [w._serve_cache.stats()["compiles"] for w in ws]
             t0 = time.perf_counter()
             placed = run_once()
             wall = time.perf_counter() - t0
             want_pool = "plan:" if ws else "local"
             assert placed.startswith(want_pool), (placed, want_pool)
-            return wall
+            if not repeat_probe:
+                return wall
+            post = [w._serve_cache.stats()["compiles"] for w in ws]
+            pl = client.stats()["pool"]["plan"]
+            probe = {
+                "compiles_warmup": sum(pre),
+                "compiles_repeat": sum(post),
+                "compiles_unchanged": bool(post == pre),
+                "map_warm_hits": int(pl.get("map_warm_hits", 0)),
+                "solo_fallbacks": int(
+                    pl.get("plan_solo_fallbacks", 0)),
+                "identical": True,  # asserted on every run above
+            }
+            return wall, probe
         finally:
             if daemon is not None:
                 daemon.close()
@@ -1325,10 +1386,33 @@ def _plan_distributed_scaling() -> dict:
                 except OSError:
                     pass
 
+    def lane_pair(wl_plan, wl_corpus, wl_oracle) -> dict:
+        """The 1-vs-2-modeled-lane row for one workload."""
+        o = measure(OneLaneWorker, wl_plan, wl_corpus, wl_oracle)
+        t = measure(TwoLaneWorker, wl_plan, wl_corpus, wl_oracle)
+        return {
+            "modeled_1dev_s": round(o, 3),
+            "modeled_2dev_s": round(t, 3),
+            "speedup_2w": round(o / t, 3) if t > 0 else None,
+            "identical": True,  # asserted on every run above
+        }
+
     solo_s = measure(None)           # the pre-scale-out local floor
     dist_s = measure(Worker)         # distributed, zero device time
     one_s = measure(OneLaneWorker)   # distributed, 1 modeled lane
     two_s = measure(TwoLaneWorker)   # distributed, 2 modeled lanes
+    # The v2 rows: a deep join tree and an iterative pagerank through
+    # the same 1-vs-2-lane lens, plus the warm-repeat pin — a repeat
+    # distributed submit must ride the workers' warm plan-node
+    # executables (compiles unchanged, map_warm_hits > 0).
+    join_row = lane_pair(join_plan, jcorpus, join_oracle)
+    pr_row = lane_pair(pr_plan, edges, pr_oracle)
+    _, warm = measure(Worker, join_plan, jcorpus, join_oracle,
+                      repeat_probe=True)
+    assert warm["compiles_unchanged"] and warm["map_warm_hits"] > 0, (
+        "repeat distributed plan submit recompiled on the workers",
+        warm,
+    )
     out = {
         "cores": os.cpu_count(),
         "modeled_device_ms": _POOL_DEVICE_MS,
@@ -1342,12 +1426,21 @@ def _plan_distributed_scaling() -> dict:
                 round(solo_s / dist_s, 3) if dist_s > 0 else None
             ),
         },
+        "join": join_row,
+        "pagerank": pr_row,
+        "warm_repeat": warm,
         "identical": True,  # asserted on every run above
     }
     print(
         f"[bench] plan distributed (device-modeled "
-        f"{_POOL_DEVICE_MS:.0f}ms/stage): 1 lane {one_s:.2f}s vs "
-        f"2 lanes {two_s:.2f}s ({out['speedup_2w']}x); raw CPU on "
+        f"{_POOL_DEVICE_MS:.0f}ms/stage): tfidf 1 lane {one_s:.2f}s vs "
+        f"2 lanes {two_s:.2f}s ({out['speedup_2w']}x), join "
+        f"{join_row['modeled_1dev_s']}s vs {join_row['modeled_2dev_s']}s "
+        f"({join_row['speedup_2w']}x), pagerank "
+        f"{pr_row['modeled_1dev_s']}s vs {pr_row['modeled_2dev_s']}s "
+        f"({pr_row['speedup_2w']}x); warm repeat: compiles "
+        f"{warm['compiles_repeat']} (unchanged), "
+        f"{warm['map_warm_hits']} warm map hits; raw CPU on "
         f"{out['cores']} core(s): solo {solo_s:.2f}s vs distributed "
         f"{dist_s:.2f}s ({out['raw']['speedup_2w']}x)",
         file=sys.stderr,

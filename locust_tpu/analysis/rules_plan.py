@@ -22,7 +22,14 @@ both sides honest as they do:
     literal appears there), exercised under ``tests/`` (quoted), and
     documented in ``docs/PLAN.md`` (backticked) — a kind the compiler
     cannot lower is a validation-passes/dispatch-explodes trap, and an
-    untested or undocumented kind is an unanchored contract.
+    untested or undocumented kind is an unanchored contract;
+  * every registry entry must be COVERED by the distributed planner:
+    matched (``.kind`` comparison / constructed) in
+    ``plan/distribute.py`` or explicitly listed in its ``SOLO_ONLY``
+    registry — two-sided, so a new kind can never silently stay
+    undistributed (the silent-solo-demotion bug class), and a stale
+    ``SOLO_ONLY`` entry for a kind distribute.py now matches is flagged
+    too.
 
 R015 applies the same stance to the optimizer's ``REWRITE_RULES``
 registry (``locust_tpu/plan/optimize.py``): every
@@ -45,35 +52,48 @@ from locust_tpu.analysis.core import Finding, Rule, call_name
 
 PLAN_NODES_REL = "locust_tpu/plan/nodes.py"
 PLAN_COMPILE_REL = "locust_tpu/plan/compile.py"
+PLAN_DISTRIBUTE_REL = "locust_tpu/plan/distribute.py"
 PLAN_DOCS_REL = "docs/PLAN.md"
 
 _CTOR_NAMES = {"node", "Node"}
 
 
-def _parse_kinds(files, root, rel):
-    """The NODE_KINDS tuple literal: {kind: line} (None when absent)."""
+def _parse_str_tuple(files, root, rel, name):
+    """A module-level ``NAME = ("a", "b", ...)`` tuple literal (plain or
+    annotated assignment): {entry: line}, {} for an EMPTY tuple (a valid
+    registry), None when the module or assignment is absent."""
     from locust_tpu.analysis.core import parse_registry_module
 
     tree = parse_registry_module(files, root, rel)
     if tree is None:
         return None
     for node in tree.body:
-        if (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == "NODE_KINDS"
-                for t in node.targets
-            )
-            and isinstance(node.value, (ast.Tuple, ast.List))
+        value = None
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in node.targets
         ):
-            kinds = {}
-            for elt in node.value.elts:
+            value = node.value
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == name
+        ):
+            value = node.value
+        if isinstance(value, (ast.Tuple, ast.List)):
+            out = {}
+            for elt in value.elts:
                 if isinstance(elt, ast.Constant) and isinstance(
                     elt.value, str
                 ):
-                    kinds[elt.value] = elt.lineno
-            return kinds
+                    out[elt.value] = elt.lineno
+            return out
     return None
+
+
+def _parse_kinds(files, root, rel):
+    """The NODE_KINDS tuple literal: {kind: line} (None when absent)."""
+    return _parse_str_tuple(files, root, rel, "NODE_KINDS")
 
 
 def _ctor_kind(call: ast.Call) -> str | None:
@@ -121,6 +141,7 @@ class PlanRegistryRule(Rule):
     # Overridable for fixture trees in tests (the R004/R011 pattern).
     nodes_rel = PLAN_NODES_REL
     compile_rel = PLAN_COMPILE_REL
+    distribute_rel = PLAN_DISTRIBUTE_REL
     docs_rel = PLAN_DOCS_REL
     analyzer_tests_rel = "tests/test_analysis.py"
 
@@ -137,7 +158,10 @@ class PlanRegistryRule(Rule):
         plan_prefix = os.path.dirname(self.nodes_rel) + "/"
 
         # Side 1: every constructed/matched kind literal is registered.
+        # The same walk collects distribute.py's matched kinds as side
+        # 3's coverage evidence (matcher arms + constructions there).
         compile_literals: set[str] = set()
+        distribute_matched: set[str] = set()
         for sf in files:
             in_locust = sf.rel.split("/", 1)[0] == "locust_tpu" or \
                 sf.rel.startswith(plan_prefix)
@@ -161,6 +185,8 @@ class PlanRegistryRule(Rule):
                     plan_prefix
                 ):
                     found = _match_kinds(node)
+                if found and sf.rel == self.distribute_rel:
+                    distribute_matched.update(found)
                 for k in found:
                     if k not in kinds:
                         yield Finding(
@@ -217,6 +243,48 @@ class PlanRegistryRule(Rule):
                     f"NODE_KINDS entry {kind!r} is undocumented in "
                     f"{self.docs_rel} (backtick the kind in the node "
                     "catalog)",
+                )
+
+        # Side 3: distributed coverage, two-sided.  Every kind either
+        # participates in a distributed shape (matched in
+        # plan/distribute.py) or is explicitly distribution-exempt in
+        # its SOLO_ONLY registry — and an exemption for a kind
+        # distribute.py matches is stale and flagged.
+        solo_only = _parse_str_tuple(
+            files, root, self.distribute_rel, "SOLO_ONLY"
+        )
+        if solo_only is None:
+            yield Finding(
+                self.rule_id, self.distribute_rel, 1, 0,
+                "cannot parse the SOLO_ONLY registry (module missing or "
+                "no module-level `SOLO_ONLY = (...)` tuple literal) — "
+                "distributed coverage of NODE_KINDS cannot be verified",
+            )
+            return
+        for k, line in sorted(solo_only.items()):
+            if k not in kinds:
+                yield Finding(
+                    self.rule_id, self.distribute_rel, line, 0,
+                    f"SOLO_ONLY entry {k!r} is not a NODE_KINDS entry "
+                    f"({self.nodes_rel}) — an exemption for a kind that "
+                    "does not exist hides a typo",
+                )
+            elif k in distribute_matched:
+                yield Finding(
+                    self.rule_id, self.distribute_rel, line, 0,
+                    f"SOLO_ONLY entry {k!r} is matched in "
+                    f"{self.distribute_rel} — the exemption is stale; "
+                    "drop it so the coverage claim stays honest",
+                )
+        for kind, line in sorted(kinds.items()):
+            if kind not in distribute_matched and kind not in solo_only:
+                yield Finding(
+                    self.rule_id, self.nodes_rel, line, 0,
+                    f"NODE_KINDS entry {kind!r} is neither matched in "
+                    f"{self.distribute_rel} nor registered SOLO_ONLY "
+                    "there — a new kind must either join a distributed "
+                    "shape or declare itself solo-only, never silently "
+                    "stay undistributed",
                 )
 
 

@@ -65,6 +65,49 @@ def pagerank(
     return ranks
 
 
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def pagerank_prep(src: jax.Array, num_nodes: int):
+    """The loop-invariant state of ``pagerank`` as a standalone jit:
+    (inv_deg, dangling mask) from the FULL edge source column — spelled
+    exactly as the fused kernel above so the distributed epoch sweep
+    (plan/distribute.py IterateShape) reproduces its bits."""
+    deg = jax.ops.segment_sum(
+        jnp.ones_like(src, dtype=jnp.float32), src, num_segments=num_nodes
+    )
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+    return inv_deg, deg == 0
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes",))
+def pagerank_step(
+    src: jax.Array,
+    dst: jax.Array,
+    ranks: jax.Array,
+    inv_deg: jax.Array,
+    dangling: jax.Array,
+    damping,
+    num_nodes: int,
+) -> jax.Array:
+    """ONE ``pagerank`` iteration as a standalone jit, bit-identical to
+    the scan body above.  ``damping`` is a TRACED f32 operand on
+    purpose: the fused kernel traces it too, so ``(1-damping)/n``
+    computes in f32 on device — marking it static would constant-fold
+    that expression in python float64 and change the low bits (pinned
+    by tests/test_serve.py's distributed-iterate identity).
+
+    Epoch sharding rides dst-restriction: calling this with the edge
+    SUBSET ``dst in [lo, hi)`` (full ranks/inv_deg/dangling vectors)
+    yields a vector whose ``[lo:hi)`` slice is bit-identical to the
+    full step's — segment_sum contributions land only on in-range dst,
+    and the dangling/teleport terms are global scalars either way.
+    """
+    contrib = _contributions(src, dst, ranks, inv_deg, num_nodes)
+    dangling_mass = jnp.sum(jnp.where(dangling, ranks, 0.0))
+    return (1.0 - damping) / num_nodes + damping * (
+        contrib + dangling_mass / num_nodes
+    )
+
+
 class DistributedPageRank:
     """Edge-sharded PageRank on a mesh: local segment_sum + psum combine.
 

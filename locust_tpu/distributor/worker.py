@@ -116,6 +116,13 @@ class Worker:
             # can never go stale; 2 entries bound the memory.
             self._serve_corpus: dict[str, list] = {}
             self._serve_corpus_lock = threading.Lock()
+            # Iterate-stage loop invariants (parsed edges, shard-
+            # filtered columns, prep vectors) keyed by (sha, n, shard
+            # layout): an N-epoch sweep sends N stage RPCs referencing
+            # ONE graph — without this every epoch re-parses and
+            # re-preps.  Content-addressed keys never go stale.
+            self._iterate_graphs: dict[tuple, tuple] = {}
+            self._iterate_lock = threading.Lock()
         # support_binary=False emulates a pre-binary (JSON-only) peer:
         # negotiation requests are ignored and every reply is a JSON
         # frame — the version-skew interop tests pin that an old worker
@@ -608,6 +615,10 @@ class Worker:
                     return self._plan_map_stage(req)
                 if phase == "reduce":
                     return self._plan_reduce_stage(req)
+                if phase == "join":
+                    return self._plan_join_stage(req)
+                if phase == "iterate":
+                    return self._plan_iterate_stage(req)
                 return {"status": "error",
                         "error": f"unknown plan stage phase {phase!r}"}
         except Exception as e:  # noqa: BLE001 - structured, worker survives
@@ -657,21 +668,41 @@ class Worker:
             return {"status": "error", "error": str(e)}
         sl = lines[a:b]
         truncated, overflow = False, 0
+        warm = False
         if fold == "wordcount":
             spec = JobSpec(tenant="pool", workload="wordcount", cfg=cfg)
             n_blocks, bucket = batching.job_shape(len(sl), cfg)
             ckey = f"{sha}:{a}:{b}"
+            node_fp = str(req.get("node_fp") or "")
             job = Job(
                 job_id=f"plan-{plan_fp}-s{split}", spec=spec,
                 corpus_digest=ckey, n_lines=len(sl), n_blocks=n_blocks,
                 bucket=bucket,
             )
             with self._map_lock:  # one accelerator: folds serialize
-                engine, _hit = self._serve_cache.lookup(spec, 1, bucket)
+                if node_fp:
+                    # Warm by the fold node's CLOSURE fingerprint
+                    # (cache.fold_node_key): a repeat distributed plan
+                    # — alpha-renamed included — lands every map split
+                    # on this worker's already-compiled executable, so
+                    # ``compiles`` stays flat on resubmit (the warm
+                    # economics PR 11 proved for whole serve jobs).
+                    engine, warm = self._serve_cache.lookup_fold_node(
+                        node_fp, cfg, 1, bucket
+                    )
+                else:
+                    engine, warm = self._serve_cache.lookup(
+                        spec, 1, bucket
+                    )
                 res = batching.dispatch_batch(
                     engine, [job], {ckey: sl}
                 )[0]
-                self._serve_cache.mark_compiled(spec, 1, bucket)
+                if node_fp:
+                    self._serve_cache.mark_compiled_fold_node(
+                        node_fp, cfg.fingerprint(), 1, bucket
+                    )
+                else:
+                    self._serve_cache.mark_compiled(spec, 1, bucket)
                 pairs = res.to_host_pairs()
                 truncated = bool(res.truncated)
                 overflow = int(res.overflow_tokens)
@@ -706,6 +737,7 @@ class Worker:
             "parts": parts,
             "truncated": truncated,
             "overflow_tokens": overflow,
+            "warm": bool(warm),
         }
 
     def _plan_reduce_stage(self, req: dict) -> dict:
@@ -718,14 +750,35 @@ class Worker:
         structured error naming ``lost_split`` so the coordinator
         recomputes exactly that map split from its durable corpus split,
         not the whole plan."""
-        from locust_tpu.plan import distribute
-
         try:
             part = int(req["part"])
             key_width = int(req["key_width"])
             inputs = list(req["inputs"])
         except (KeyError, TypeError, ValueError) as e:
             return {"status": "error", "error": f"bad plan_stage: {e}"}
+        me = f"{self.addr[0]}:{self.addr[1]}"
+        acc, err = self._merge_partition_inputs(inputs, key_width, part)
+        if err is not None:
+            return err
+        return {
+            "status": "ok",
+            "part": part,
+            "worker": me,
+            "pairs": [
+                [base64.b64encode(k).decode(), int(v)]
+                for k, v in sorted(acc.items())
+            ],
+        }
+
+    def _merge_partition_inputs(
+        self, inputs: list, key_width: int, part: int
+    ) -> tuple[dict | None, dict | None]:
+        """The reduce/join stages' shared input gather: read (local) or
+        pull (remote) every per-split partition file for one bin and
+        sum-merge.  Returns (table, None) or (None, structured error
+        reply naming ``lost_split``)."""
+        from locust_tpu.plan import distribute
+
         me = f"{self.addr[0]}:{self.addr[1]}"
         acc: dict = {}
         for ref in inputs:
@@ -735,8 +788,8 @@ class Worker:
                 owner = str(ref["worker"])
                 split = int(ref["split"])
             except (KeyError, TypeError, ValueError):
-                return {"status": "error",
-                        "error": f"bad partition ref {ref!r}"}
+                return None, {"status": "error",
+                              "error": f"bad partition ref {ref!r}"}
             if int(ref.get("pairs", 1)) == 0:
                 continue  # published empty: nothing to move or merge
             try:
@@ -747,7 +800,7 @@ class Worker:
                         owner, path, sha, key_width, part
                     )
             except Exception as e:  # noqa: BLE001 - structured loss report
-                return {
+                return None, {
                     "status": "error",
                     "lost_split": split,
                     "error": f"partition input lost (split {split}, "
@@ -755,15 +808,201 @@ class Worker:
                              f"{type(e).__name__}: {e}",
                 }
             distribute.merge_pairs(acc, pairs)
+        return acc, None
+
+    def _plan_join_stage(self, req: dict) -> dict:
+        """Evaluate one co-partitioned hash-join bin, tree-deep.
+
+        The bin's wordcount table merges from its per-split partition
+        inputs exactly like a reduce stage; then the WHOLE join tree
+        evaluates over it locally (``distribute.eval_tree_doc`` — host
+        Python ints, the solo ``_eval_join`` semantics) — however deep
+        the tree, the bin never returns to the master between joins
+        (docs/PLAN.md "Distributed execution").  ``distinct`` reports
+        the bin's pre-join table size so the coordinator can prove the
+        solo fold would not have truncated (its capacity gate)."""
+        from locust_tpu.plan import distribute
+
+        try:
+            part = int(req["part"])
+            key_width = int(req["key_width"])
+            inputs = list(req["inputs"])
+            tree = list(req["tree"])
+        except (KeyError, TypeError, ValueError) as e:
+            return {"status": "error", "error": f"bad plan_stage: {e}"}
+        me = f"{self.addr[0]}:{self.addr[1]}"
+        acc, err = self._merge_partition_inputs(inputs, key_width, part)
+        if err is not None:
+            return err
+        try:
+            joined = distribute.eval_tree_doc(tree, acc)
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            return {"status": "error",
+                    "error": f"bad join tree {tree!r}: {e}"}
         return {
             "status": "ok",
             "part": part,
             "worker": me,
+            "distinct": len(acc),
             "pairs": [
                 [base64.b64encode(k).decode(), int(v)]
-                for k, v in sorted(acc.items())
+                for k, v in sorted(joined.items())
             ],
         }
+
+    def _plan_iterate_stage(self, req: dict) -> dict:
+        """One pagerank epoch on one rank shard (docs/PLAN.md
+        "Distributed execution").
+
+        The worker holds the loop-invariant graph state (edge arrays,
+        inv_deg, dangling mask — cached per corpus sha, shard-filtered
+        to ``dst in [lo, hi)``), reconstructs the previous epoch's full
+        rank vector from ALL shards' published partitions (shard order
+        is node order), runs ONE bit-exact ``pagerank_step`` and
+        publishes its own slice for the next epoch.  Epoch 1 starts
+        from the solo path's exact ``ranks0``.  A lost input partition
+        answers structured ``(lost_epoch, lost_split)`` so the
+        coordinator recomputes exactly that (epoch, shard) stage."""
+        import numpy as np
+
+        from locust_tpu.plan import distribute
+
+        try:
+            sha = str(req["sha"])
+            spill_dir = str(req["spill_dir"])
+            plan_fp = str(req["plan_fp"])
+            epoch = int(req["epoch"])       # 1-based sweep number
+            shard = int(req["shard"])
+            n_shards = int(req["n_shards"])
+            num_nodes = int(req["num_nodes"])
+            damping = float(req["damping"])
+            attempt = int(req["attempt"])
+            inputs = req.get("inputs")      # None on epoch 1
+        except (KeyError, TypeError, ValueError) as e:
+            return {"status": "error", "error": f"bad plan_stage: {e}"}
+        try:
+            src_sub, dst_sub, inv_deg, dangling = self._iterate_graph(
+                sha, spill_dir, num_nodes, shard, n_shards
+            )
+        except ValueError as e:
+            return {"status": "error", "error": str(e)}
+        me = f"{self.addr[0]}:{self.addr[1]}"
+        if inputs is None:
+            # The solo scan's exact ranks0: 1/n rounded double->f32.
+            ranks = np.full(
+                (num_nodes,), 1.0 / num_nodes, dtype=np.float32
+            )
+        else:
+            slices = []
+            for ref in sorted(inputs, key=lambda r: int(r["part"])):
+                try:
+                    path = str(ref["path"])
+                    rsha = str(ref["sha256"])
+                    owner = str(ref["worker"])
+                    part = int(ref["part"])
+                except (KeyError, TypeError, ValueError):
+                    return {"status": "error",
+                            "error": f"bad partition ref {ref!r}"}
+                try:
+                    if owner == me or os.path.exists(path):
+                        pairs = distribute.read_partition(
+                            path, rsha, distribute.RANK_KEY_WIDTH
+                        )
+                    else:
+                        pairs = self._pull_partition(
+                            owner, path, rsha,
+                            distribute.RANK_KEY_WIDTH, part,
+                        )
+                except Exception as e:  # noqa: BLE001 - structured loss
+                    return {
+                        "status": "error",
+                        "lost_split": part,
+                        "lost_epoch": epoch - 1,
+                        "error": f"rank partition lost (epoch "
+                                 f"{epoch - 1}, shard {part}, {owner}): "
+                                 f"{type(e).__name__}: {e}",
+                    }
+                slices.append(distribute.decode_rank_values(pairs))
+            ranks = np.concatenate(slices) if slices else np.zeros(
+                0, np.float32
+            )
+            if len(ranks) != num_nodes:
+                return {"status": "error",
+                        "error": f"rank vector reassembled {len(ranks)} "
+                                 f"of {num_nodes} nodes"}
+        from locust_tpu.apps.pagerank import pagerank_step
+
+        lo, hi = distribute.shard_ranges(num_nodes, n_shards)[shard]
+        with self._map_lock:  # one accelerator: device steps serialize
+            new = np.asarray(pagerank_step(
+                src_sub, dst_sub, ranks, inv_deg, dangling,
+                damping, num_nodes,
+            ))
+        ref = distribute.publish_partition(
+            distribute.partition_path(
+                spill_dir, plan_fp, epoch, shard, attempt
+            ),
+            distribute.encode_rank_pairs(lo, new[lo:hi]),
+        )
+        ref["part"] = shard
+        return {
+            "status": "ok",
+            "epoch": epoch,
+            "shard": shard,
+            "attempt": attempt,
+            "worker": me,
+            "ref": ref,
+        }
+
+    def _iterate_graph(
+        self, sha: str, spill_dir: str, num_nodes: int, shard: int,
+        n_shards: int,
+    ) -> tuple:
+        """The iterate stages' loop-invariant state, cached per (corpus
+        sha, num_nodes, shard layout): parsed edge arrays restricted to
+        this shard's dst range plus the FULL inv_deg/dangling vectors
+        (``pagerank_prep``, bit-exact vs the solo kernel's prologue).
+        Raises ``ValueError`` on a missing/damaged spill or a corpus
+        that does not parse as an edge list."""
+        import numpy as np
+
+        from locust_tpu.plan import distribute
+
+        key = (sha, int(num_nodes), int(n_shards), int(shard))
+        with self._iterate_lock:
+            ent = self._iterate_graphs.pop(key, None)
+            if ent is not None:
+                self._iterate_graphs[key] = ent  # LRU touch
+                return ent
+        path = os.path.join(spill_dir, f"{sha}.bin")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise ValueError(f"corpus spill unreadable: {e}")
+        if hashlib.sha256(data).hexdigest() != sha:
+            raise ValueError(f"corpus spill {sha} fails its content hash")
+        from locust_tpu.apps.pagerank import pagerank_prep
+        from locust_tpu.plan.compile import PlanError, edges_from_bytes
+
+        try:
+            src, dst = edges_from_bytes(data)
+        except PlanError as e:
+            raise ValueError(f"corpus is not an edge list: {e}")
+        src = np.asarray(src, np.int32)
+        dst = np.asarray(dst, np.int32)
+        with self._map_lock:
+            inv_deg, dangling = pagerank_prep(src, num_nodes)
+            inv_deg = np.asarray(inv_deg)
+            dangling = np.asarray(dangling)
+        lo, hi = distribute.shard_ranges(num_nodes, n_shards)[shard]
+        mask = (dst >= lo) & (dst < hi)
+        ent = (src[mask], dst[mask], inv_deg, dangling)
+        with self._iterate_lock:
+            self._iterate_graphs[key] = ent
+            while len(self._iterate_graphs) > 4:
+                self._iterate_graphs.pop(next(iter(self._iterate_graphs)))
+        return ent
 
     def _pull_partition(
         self, owner: str, path: str, sha: str, key_width: int, part: int

@@ -82,6 +82,8 @@ NAMES = {
     "plan.rewrites": "counter",     # optimizer rewrites applied (optimize.py)
     "plan.subcache_hits": "counter",    # sub-plan result cache hits
     "plan.subcache_misses": "counter",  # ... and fold recomputes paid
+    "plan.solo_fallbacks": "counter",   # plan jobs demoted to the solo engine
+    "plan.map_warm_hits": "counter",    # map stages on warm fold-node executables
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")

@@ -7,10 +7,20 @@ engine, so a plan got none of the pool's retry/quarantine machinery and
 none of the scale-out.  This module is the Dean & Ghemawat answer
 applied to the plan layer (docs/PLAN.md "Distributed execution"):
 
-  * ``plan_shape()`` recognizes the map->shuffle->reduce[->score]->sink
-    spine the engine's folds cover (the same closed ``_FOLDS`` table
-    ``plan/compile.py`` lowers) and returns its distributable shape —
-    anything else stays on the solo path, byte-identical by refusal;
+  * ``plan_shape()`` recognizes every distributable plan shape and
+    returns ``(shape, reason)``: the map->shuffle->reduce[->score]->sink
+    fold spine (``StageShape``, the same closed ``_FOLDS`` table
+    ``plan/compile.py`` lowers), join trees of wordcount spines
+    (``JoinShape`` — the distributed hash-join: co-partitioned bins,
+    per-worker tree evaluation), and the pagerank ``iterate`` loop
+    (``IterateShape`` — epoch-synchronized rank-shard sweeps).
+    Anything else stays on the solo path, byte-identical by refusal,
+    and ``reason`` names exactly why (the demotion log / counter and
+    the tests read it; ``None`` shape always carries a reason).  Kinds
+    that are distribution-exempt BY DESIGN live in the ``SOLO_ONLY``
+    registry — analysis rule R014 enforces two-sided that every
+    ``NODE_KINDS`` entry is either matched here or listed there, so a
+    new kind can never silently stay undistributed;
   * **stage programs**: source splits ride the content-addressed corpus
     spill, each map split folds on a worker's warm executables, and the
     shuffle edge moves keyed partitions worker-to-worker over the
@@ -45,12 +55,22 @@ import dataclasses
 import hashlib
 import os
 
+import numpy as np
+
 from locust_tpu import obs
 from locust_tpu.io import serde
 from locust_tpu.utils import faultplan
 
 from .compile import _FOLDS
 from .nodes import Plan
+
+# Node kinds that are distribution-exempt BY DESIGN (R014's two-sided
+# distributed-coverage check: every NODE_KINDS entry must either appear
+# in a ``.kind`` match below or be listed here with a reason).  Empty
+# today: every kind participates in at least one distributed shape —
+# source/map/shuffle/reduce as the fold spine, join as the hash-join
+# tree, iterate as the epoch sweep, sink as the terminal render.
+SOLO_ONLY: tuple = ()
 
 # Doc-id suffix budget for composite (word, doc) partition keys: the doc
 # id rides a uint32 key lane (apps/tfidf.py), so <= 10 decimal digits
@@ -66,70 +86,229 @@ PAIR_SEP = b"\x00"
 
 @dataclasses.dataclass(frozen=True)
 class StageShape:
-    """The distributable spine of a validated plan: which engine fold
-    the map+reduce pair lowers to, how source lines map to doc ids,
-    whether a tfidf_score stage follows the fold, and the sink op that
-    renders the final table."""
+    """The distributable fold spine of a validated plan: which engine
+    fold the map+reduce pair lowers to, how source lines map to doc
+    ids, whether a tfidf_score stage follows the fold, and the sink op
+    that renders the final table."""
 
     fold: str           # "wordcount" | "tf" | "index" (compile._FOLDS)
     lines_per_doc: int  # source param (doc ids are GLOBAL line//k)
     score: bool         # a map/tfidf_score stage between reduce and sink
     sink_op: str        # "table" | "tfidf" | "postings"
+    node_fp: str = ""   # closure fp of the reduce node (warm-cache key)
 
 
-def plan_shape(plan: Plan) -> StageShape | None:
-    """Recognize the map->shuffle->reduce[->score]->sink spine, or None.
+@dataclasses.dataclass(frozen=True)
+class FoldLeaf:
+    """One wordcount fold spine feeding a join tree (the only leaf type
+    the ``join`` signature admits: its inputs are "table"s, and only
+    (tokenize_count, sum) over corpus text produces one)."""
 
-    None means the plan stays on the solo engine (pagerank iteration,
-    joins, multi-consumer DAGs, named inputs): the solo path is the
-    correctness floor and refusal here can never change an answer.
+    lines_per_doc: int
+    node_fp: str    # closure fp of the leaf's reduce node
+    reduce_id: str
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinTree:
+    """One ``join`` node in a recognized tree: combine op + children
+    (each a FoldLeaf or a deeper JoinTree — depth is unbounded, the
+    whole tree evaluates per-bin on one worker without returning to
+    the master)."""
+
+    combine: str            # "sum" | "mul" | "min" (nodes.JOIN_COMBINES)
+    left: object            # FoldLeaf | JoinTree
+    right: object           # FoldLeaf | JoinTree
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinShape:
+    """A distributable join plan: a tree of inner-joins over wordcount
+    fold leaves.  Executes as ONE shared map wave (every leaf is the
+    same corpus wordcount fold, so alpha-equivalent leaves share their
+    shuffle partitions) plus one join wave that co-partitions by key
+    hash and evaluates the whole tree per bin."""
+
+    tree: JoinTree
+    leaves: tuple           # distinct FoldLeafs, deterministic order
+    sink_op: str            # "table" (the join signature's output)
+    depth: int              # join nodes on the longest root->leaf path
+
+
+@dataclasses.dataclass(frozen=True)
+class IterateShape:
+    """A distributable pagerank plan: epoch-synchronized sweeps over
+    per-worker rank shards, one rank shuffle per iteration."""
+
+    num_iters: int
+    damping: float          # traced f32 on device (bit-parity w/ solo)
+    node_fp: str            # closure fp of the iterate node
+    sink_op: str            # "ranks"
+
+
+def _fold_spine(plan, by_id, reducer, seen: set):
+    """Recognize reduce<-shuffle<-map<-source(text, corpus) ending at
+    ``reducer``; returns (fold name, source node, None) or
+    (None, None, reason).  ``seen`` collects the spine's node ids for
+    the caller's whole-plan coverage check."""
+    shuffle = by_id[reducer.inputs[0]]
+    if shuffle.kind != "shuffle":
+        return None, None, "fold_feed_not_shuffle"
+    mapper = by_id[shuffle.inputs[0]]
+    if mapper.kind != "map":
+        return None, None, "shuffle_feed_not_map"
+    src = by_id[mapper.inputs[0]]
+    if src.kind != "source" or src.op != "text":
+        return None, None, "source_not_corpus_text"
+    if src.param("input", "corpus") != "corpus":
+        return None, None, "source_named_input"
+    fold = _FOLDS.get((mapper.op, reducer.op))
+    if fold is None:
+        return None, None, "unlowered_fold"
+    seen.update((reducer.id, shuffle.id, mapper.id, src.id))
+    return fold, src, None
+
+
+def _join_tree(plan, by_id, nid: str, seen: set, memo: dict):
+    """Walk a join tree rooted at ``nid``: every internal node an
+    inner-join, every leaf a wordcount fold spine.  Shared sub-trees
+    (CSE'd plans) memoize by node id.  Returns (tree, None) or
+    (None, reason)."""
+    if nid in memo:
+        return memo[nid], None
+    node = by_id[nid]
+    if node.kind == "join":
+        left, reason = _join_tree(plan, by_id, node.inputs[0], seen, memo)
+        if left is None:
+            return None, reason
+        right, reason = _join_tree(plan, by_id, node.inputs[1], seen, memo)
+        if right is None:
+            return None, reason
+        seen.add(node.id)
+        out = JoinTree(
+            combine=node.param("combine", "sum"), left=left, right=right
+        )
+    elif node.kind == "reduce":
+        fold, src, reason = _fold_spine(plan, by_id, node, seen)
+        if fold is None:
+            return None, reason
+        if fold != "wordcount":
+            # Typing already forces this (join inputs are "table"s and
+            # only the wordcount fold makes one) — belt for the check.
+            return None, "join_leaf_not_wordcount"
+        out = FoldLeaf(
+            lines_per_doc=int(src.param("lines_per_doc", 1)),
+            node_fp=plan.node_fingerprint(node.id),
+            reduce_id=node.id,
+        )
+    else:
+        return None, "join_input_not_fold_or_join"
+    memo[nid] = out
+    return out, None
+
+
+def _tree_depth(tree) -> int:
+    if isinstance(tree, FoldLeaf):
+        return 0
+    return 1 + max(_tree_depth(tree.left), _tree_depth(tree.right))
+
+
+def _tree_leaves(tree, out: list) -> list:
+    if isinstance(tree, FoldLeaf):
+        if tree not in out:
+            out.append(tree)
+    else:
+        _tree_leaves(tree.left, out)
+        _tree_leaves(tree.right, out)
+    return out
+
+
+def plan_shape(plan: Plan):
+    """Recognize a plan's distributable shape.
+
+    Returns ``(shape, reason)``: shape is a StageShape / JoinShape /
+    IterateShape and reason is None, or shape is None and reason is a
+    short stable string naming WHY the plan stays on the solo engine
+    (multi-consumer DAGs, named inputs, unlowered folds...).  The solo
+    path is the correctness floor and refusal here can never change an
+    answer — but it is never silent: the daemon logs the reason once
+    per shape and counts it (``plan_solo_fallbacks``).
     """
     by_id = plan.by_id()
     try:
-        sink = plan.sink()
+        sink = next(n for n in plan.nodes if n.kind == "sink")
     except StopIteration:  # pragma: no cover - validation owns this
-        return None
-    n_expected = 5
+        return None, "no_sink"
     child = by_id[sink.inputs[0]]
+
+    if child.kind == "iterate":
+        if child.op != "pagerank":  # pragma: no cover - closed NODE_OPS
+            return None, "iterate_op_uncovered"
+        src = by_id[child.inputs[0]]
+        if src.kind != "source" or src.op != "edges":
+            return None, "iterate_source_not_edges"
+        if src.param("input", "corpus") != "corpus":
+            return None, "source_named_input"
+        if sink.op != "ranks":  # pragma: no cover - typing owns this
+            return None, "iterate_sink_not_ranks"
+        if len(plan.nodes) != 3:
+            return None, "extra_nodes"
+        return IterateShape(
+            num_iters=int(child.param("num_iters", 20)),
+            damping=float(child.param("damping", 0.85)),
+            node_fp=plan.node_fingerprint(child.id),
+            sink_op=sink.op,
+        ), None
+
+    if child.kind == "join":
+        if sink.op != "table":  # pragma: no cover - typing owns this
+            return None, "join_sink_not_table"
+        seen: set = {sink.id}
+        tree, reason = _join_tree(plan, by_id, child.id, seen, {})
+        if tree is None:
+            return None, reason
+        if seen != set(by_id):
+            # Extra consumers hanging off the tree (a tee re-reading a
+            # leaf table) would change what the join wave must produce.
+            return None, "extra_nodes"
+        return JoinShape(
+            tree=tree,
+            leaves=tuple(_tree_leaves(tree, [])),
+            sink_op=sink.op,
+            depth=_tree_depth(tree),
+        ), None
+
+    n_expected = 5
     score = False
     if child.kind == "map" and child.op == "tfidf_score":
         score = True
         n_expected += 1
         child = by_id[child.inputs[0]]
     if child.kind != "reduce":
-        return None
+        return None, "sink_feed_not_reduce"
     reducer = child
-    shuffle = by_id[reducer.inputs[0]]
-    if shuffle.kind != "shuffle":
-        return None
-    mapper = by_id[shuffle.inputs[0]]
-    if mapper.kind != "map":
-        return None
-    src = by_id[mapper.inputs[0]]
-    if src.kind != "source" or src.op != "text":
-        return None
-    if src.param("input", "corpus") != "corpus":
-        return None
-    fold = _FOLDS.get((mapper.op, reducer.op))
+    seen = set()
+    fold, src, reason = _fold_spine(plan, by_id, reducer, seen)
     if fold is None:
-        return None
+        return None, reason
     # Exact node count rejects extra consumers hanging off the spine
     # (a second sink is impossible, but a join/tee re-reading the table
     # would change what the distributed fold must produce).
     if len(plan.nodes) != n_expected:
-        return None
+        return None, "extra_nodes"
     if (fold, score, sink.op) not in (
         ("wordcount", False, "table"),
         ("tf", True, "tfidf"),
         ("index", False, "postings"),
     ):
-        return None
+        return None, "uncovered_sink_combo"
     return StageShape(
         fold=fold,
         lines_per_doc=int(src.param("lines_per_doc", 1)),
         score=score,
         sink_op=sink.op,
-    )
+        node_fp=plan.node_fingerprint(reducer.id),
+    ), None
 
 
 # ------------------------------------------------------- shuffle keying
@@ -334,3 +513,101 @@ def finalize(
         postings.setdefault(word, set()).add(int(doc))
     postings = {w: sorted(d) for w, d in postings.items()}
     return _render("postings", postings), len(postings), False, 0
+
+
+# ------------------------------------------------------------ join trees
+
+# The one spelling of the inner-join combine ops — MUST mirror
+# compile._eval_join exactly: host Python ints, so a "mul" join's
+# products never wrap int32 the way a device merge would.
+JOIN_OPS = {
+    "sum": lambda a, b: a + b,
+    "mul": lambda a, b: a * b,
+    "min": min,
+}
+
+
+def tree_doc(tree) -> list:
+    """Serialize a JoinTree for the stage RPC wire: nested JSON lists
+    ``["join", combine, left, right]`` with ``["leaf"]`` terminals.
+    Every leaf of a covered join tree is the SAME corpus wordcount
+    table (the join signature only admits wordcount folds over the one
+    corpus), so the wire form needs no per-leaf identity."""
+    if isinstance(tree, FoldLeaf):
+        return ["leaf"]
+    return ["join", tree.combine, tree_doc(tree.left), tree_doc(tree.right)]
+
+
+def eval_tree_doc(doc: list, table: dict) -> dict:
+    """Evaluate a serialized join tree over one co-partitioned bin's
+    wordcount table: inner-join semantics exactly as the solo
+    ``compile._eval_join`` (key in both sides, ``op(left, right)``).
+    Restricting to one hash bin is exact because ``partition_of``
+    routes every key of every leaf to the same bin."""
+    if doc[0] == "leaf":
+        return table
+    _, combine, left_doc, right_doc = doc
+    left = eval_tree_doc(left_doc, table)
+    right = eval_tree_doc(right_doc, table)
+    op = JOIN_OPS[combine]
+    return {k: op(v, right[k]) for k, v in left.items() if k in right}
+
+
+def finalize_join(bin_pairs: list[list]) -> tuple[bytes, int, bool, int]:
+    """Merge the join wave's per-bin results into the solo bytes: the
+    bins are key-disjoint, so one host sort of the concatenation IS the
+    solo evaluator's ``sorted(...)`` over the whole join.  Host-side on
+    purpose — join values are unbounded Python ints (mul combines), so
+    a device sort_and_compact merge would wrap; disjointness makes the
+    compaction a no-op anyway.  Accounting mirrors solo ``_eval_join``:
+    (distinct, False, 0)."""
+    from .compile import _render
+
+    pairs = sorted(p for chunk in bin_pairs for p in chunk)
+    return _render("table", pairs), len(pairs), False, 0
+
+
+# ----------------------------------------------------------- rank shards
+
+# Rank-shuffle key lane: node ids as zero-padded decimal, one width for
+# every epoch partition (ties the LKVB row width down without a cfg).
+RANK_KEY_WIDTH = 10
+
+
+def shard_ranges(num_nodes: int, n_shards: int) -> list[tuple[int, int]]:
+    """Contiguous per-worker rank shards [lo, hi): the same balanced
+    split on every host/attempt so recomputes and WAL resumes agree."""
+    return [
+        (i * num_nodes // n_shards, (i + 1) * num_nodes // n_shards)
+        for i in range(n_shards)
+    ]
+
+
+def encode_rank_pairs(lo: int, ranks) -> list:
+    """One epoch shard's ranks as LKVB pairs: key = zero-padded node
+    id, value = the float32 BIT PATTERN as int32 (the kvbin value lane
+    is int32; a bit-cast round-trips exactly, a decimal rendering would
+    not)."""
+    bits = np.ascontiguousarray(np.asarray(ranks, np.float32)).view(
+        np.int32
+    )
+    return [(b"%010d" % (lo + i), int(bits[i])) for i in range(len(bits))]
+
+
+def decode_rank_values(pairs: list):
+    """Invert encode_rank_pairs for one partition read in row order."""
+    return np.array(
+        [v for _, v in pairs], dtype=np.int32
+    ).view(np.float32)
+
+
+def finalize_ranks(rank_slices: list) -> tuple[bytes, int, bool, int]:
+    """Concatenate the final epoch's shard slices (shard order == node
+    order) into the solo render: ``_render("ranks", ...)`` is the one
+    spelling, accounting mirrors solo ``_eval_pagerank`` (n, False, 0)."""
+    from .compile import _render
+
+    ranks = np.concatenate(
+        [np.asarray(s, np.float32) for s in rank_slices]
+    )
+    return _render("ranks", ranks), len(ranks), False, 0

@@ -14,7 +14,8 @@ journal can key and replay arbitrary pipelines instead of only named
 workloads (docs/SERVING.md "Plan submits").
 
 Closed registries (the ``faultplan.SITES`` / obs ``NAMES`` stance,
-enforced two-sided by analysis rule R014):
+enforced three-sided by analysis rule R014 — registered, lowered +
+tested + documented, and distribute-covered or SOLO_ONLY-exempt):
 
   * ``NODE_KINDS`` — the node kinds a plan may use; an unknown kind is a
     loud ``PlanError`` at construction, never a silently-ignored node;
@@ -38,10 +39,14 @@ import re
 
 PLAN_VERSION = 1
 
-# The closed node-kind registry.  Analysis rule R014 keeps it two-sided:
-# every kind literal constructed/matched under locust_tpu/ must be an
-# entry here, and every entry must be lowered in plan/compile.py,
-# exercised under tests/, and documented in docs/PLAN.md.
+# The closed node-kind registry.  Analysis rule R014 polices it from
+# three sides: every kind literal constructed/matched under locust_tpu/
+# must be an entry here; every entry must be lowered in plan/compile.py,
+# exercised under tests/, and documented in docs/PLAN.md; and every
+# entry must be matched by the distributed planner in plan/distribute.py
+# OR registered in its SOLO_ONLY tuple — so a new kind cannot silently
+# fall off the distributed surface (stale/unknown SOLO_ONLY entries are
+# findings too).
 NODE_KINDS = (
     "source",   # ingest: corpus text or an edge list
     "map",      # per-record transform / emit (or a table-level rescore)

@@ -116,11 +116,23 @@ class ExecutableCache:
             )
         return (spec.workload, spec.cfg.fingerprint())
 
-    def lookup(self, spec: JobSpec, njobs: int, bucket: int):
-        """(engine, hit) — builds the engine on a miss; the SHAPE is
-        marked compiled only by ``mark_compiled`` after the dispatch ran
-        (a dispatch that dies must not poison the ledger as warm)."""
-        key = self.engine_key(spec)
+    @staticmethod
+    def fold_node_key(node_fp: str, cfg_fp: str) -> tuple:
+        """The warm key for a distributed plan MAP STAGE's fold engine:
+        (plan-node closure fingerprint, config fingerprint).  The
+        closure fp (``Plan.node_fingerprint``) is node-id independent,
+        so an alpha-renamed resubmit of the same pipeline lands on the
+        same warm executable — and the shape bucket rides the ledger
+        exactly as for whole jobs, so a repeat distributed plan skips
+        the per-worker recompile (docs/SERVING.md, docs/PLAN.md
+        "Distributed execution")."""
+        return (PLAN_WORKLOAD, f"node:{node_fp}", cfg_fp)
+
+    def _lookup_key(self, key: tuple, njobs: int, bucket: int, build):
+        """(engine, hit) for one warm key — builds via ``build()`` on a
+        miss; the SHAPE is marked compiled only by ``_mark_key`` after
+        the dispatch ran (a dispatch that dies must not poison the
+        ledger as warm)."""
         with self._lock:
             eng = self._engines.pop(key, None)
             if eng is not None:
@@ -134,21 +146,7 @@ class ExecutableCache:
         # Build OUTSIDE the lock: engine construction imports/compiles
         # nothing device-side yet, but it is not free and must not block
         # concurrent lookups of already-warm keys.
-        if spec.plan is not None:
-            # Plan jobs hold a CompiledPlan instead of a bare engine:
-            # same LRU, same shape ledger, same warm-hit economics (the
-            # compiled plan keeps its underlying engine's jit caches).
-            from locust_tpu.plan import from_json
-            from locust_tpu.plan.compile import compile_plan
-
-            built = compile_plan(from_json(spec.plan), spec.cfg)
-        else:
-            from locust_tpu.engine import MapReduceEngine
-
-            map_fn, combine = _resolve_workload(spec.workload)
-            built = MapReduceEngine(
-                spec.cfg, map_fn=map_fn, combine=combine
-            )
+        built = build()
         with self._lock:
             eng = self._engines.get(key)
             if eng is None:  # we won the (benign) build race
@@ -164,12 +162,63 @@ class ExecutableCache:
                     self.evictions += 1
             return eng, False
 
-    def mark_compiled(self, spec: JobSpec, njobs: int, bucket: int) -> None:
+    def _mark_key(self, key: tuple, njobs: int, bucket: int) -> None:
         with self._lock:
-            key = (self.engine_key(spec), njobs, bucket)
-            if key not in self._shapes:
-                self._shapes.add(key)
+            shape = (key, njobs, bucket)
+            if shape not in self._shapes:
+                self._shapes.add(shape)
                 self.compiles += 1
+
+    def lookup(self, spec: JobSpec, njobs: int, bucket: int):
+        """(engine, hit) — builds the engine on a miss (see
+        ``_lookup_key`` for the ledger discipline)."""
+
+        def build():
+            if spec.plan is not None:
+                # Plan jobs hold a CompiledPlan instead of a bare
+                # engine: same LRU, same shape ledger, same warm-hit
+                # economics (the compiled plan keeps its underlying
+                # engine's jit caches).
+                from locust_tpu.plan import from_json
+                from locust_tpu.plan.compile import compile_plan
+
+                return compile_plan(from_json(spec.plan), spec.cfg)
+            from locust_tpu.engine import MapReduceEngine
+
+            map_fn, combine = _resolve_workload(spec.workload)
+            return MapReduceEngine(
+                spec.cfg, map_fn=map_fn, combine=combine
+            )
+
+        return self._lookup_key(self.engine_key(spec), njobs, bucket,
+                                build)
+
+    def lookup_fold_node(self, node_fp: str, cfg, njobs: int,
+                         bucket: int):
+        """(engine, hit) for a distributed plan map stage, keyed by the
+        fold node's CLOSURE fingerprint (``fold_node_key``).  Only the
+        wordcount fold dispatches device-side on workers (the composite
+        folds shuffle host-built pair tables), so the engine is always
+        the wordcount map/combine under the stage's config."""
+
+        def build():
+            from locust_tpu.engine import MapReduceEngine
+
+            map_fn, combine = _resolve_workload("wordcount")
+            return MapReduceEngine(cfg, map_fn=map_fn, combine=combine)
+
+        return self._lookup_key(
+            self.fold_node_key(node_fp, cfg.fingerprint()), njobs,
+            bucket, build,
+        )
+
+    def mark_compiled(self, spec: JobSpec, njobs: int, bucket: int) -> None:
+        self._mark_key(self.engine_key(spec), njobs, bucket)
+
+    def mark_compiled_fold_node(self, node_fp: str, cfg_fp: str,
+                                njobs: int, bucket: int) -> None:
+        self._mark_key(self.fold_node_key(node_fp, cfg_fp), njobs,
+                       bucket)
 
     def warm_shapes(self) -> list[list]:
         """Every compiled shape as ``[workload, cfg_fp, njobs, bucket]``

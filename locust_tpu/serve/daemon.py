@@ -98,6 +98,20 @@ _PRIMARY_ONLY_COMMANDS = (
 )
 
 
+class _PlanSolo(Exception):
+    """Internal control flow for the plan coordinator: demote this plan
+    job to the solo local engine, with a named reason.  Raised by the
+    distributed path's safety gates (unrecognized shape raced in, too
+    few placeable workers, a fold that would truncate where the solo
+    evaluator's accounting differs) — the handler releases placements,
+    counts ``plan_solo_fallbacks`` and runs the solo floor.  Never
+    silent (docs/PLAN.md "Distributed execution")."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
 @dataclasses.dataclass
 class ServeConfig:
     """Daemon capacity/policy knobs (docs/SERVING.md)."""
@@ -327,7 +341,18 @@ class ServeDaemon:
         self._plan_counters = {
             "stages": 0, "recomputes": 0,
             "speculated": 0, "partitions_reused": 0,
+            # Satellite of the plan-surface-v2 round: a pool-eligible
+            # plan job demoted to the solo engine is NEVER silent (the
+            # fused_demoted stance) — counted here, logged once per
+            # reason (_count_plan_solo).
+            "plan_solo_fallbacks": 0,
+            # Distributed map splits that landed on a worker's warm
+            # fold-node executable (cache.fold_node_key): a repeat
+            # distributed plan should push this up while the workers'
+            # ``compiles`` stay flat.
+            "map_warm_hits": 0,
         }
+        self._plan_solo_logged: set[str] = set()
         self._plan_progress: dict[str, list] = {}
         self._corpus_bytes: dict[str, bytes] = {}  # job_id -> in-flight bytes
         self._corpus_total = 0  # sum of _corpus_bytes values (admission cap)
@@ -1191,6 +1216,22 @@ class ServeDaemon:
     def _affinity_key(self, job: Job) -> tuple:
         return (self.executables.engine_key(job.spec), job.bucket)
 
+    def _plan_affinity_key(self, job: Job, shape) -> tuple:
+        """Pool-affinity key for a DISTRIBUTED plan job: the shape's
+        primary node closure fingerprint in the workers' fold_node_key
+        spelling (cache.ExecutableCache), so placement prefers workers
+        already holding the compiled stage executable — alpha-renamed
+        resubmits included — and a restarted daemon re-learns those
+        homes from seed_affinity's warm_shapes rows."""
+        from locust_tpu.plan import distribute
+        from locust_tpu.serve.jobs import PLAN_WORKLOAD
+
+        if isinstance(shape, distribute.JoinShape):
+            fp = shape.leaves[0].node_fp
+        else:
+            fp = shape.node_fp
+        return ((PLAN_WORKLOAD, f"node:{fp}"), job.bucket)
+
     def _shardable(self, job: Job) -> bool:
         # Plan jobs take their OWN distribution path (_plan_distributable
         # -> _dispatch_plan_distributed): the worker serve surface here
@@ -1203,11 +1244,12 @@ class ServeDaemon:
         )
 
     def _plan_shape(self, job: Job):
-        """The distributable map->shuffle->reduce spine of a plan job,
-        or None when the plan is not one of the covered shapes
-        (plan/distribute.py, docs/PLAN.md "Distributed execution")."""
+        """(shape, reason) for a plan job: the distributable shape —
+        fold spine, join tree, or pagerank iterate — or None with the
+        reason it stays solo (plan/distribute.py, docs/PLAN.md
+        "Distributed execution")."""
         if job.spec.plan is None:
-            return None
+            return None, "not_a_plan"
         try:
             from locust_tpu.plan import distribute, from_json
 
@@ -1217,20 +1259,45 @@ class ServeDaemon:
                 "plan job %s not distributable (%s: %s); solo engine",
                 job.job_id, type(e).__name__, e,
             )
-            return None
+            return None, f"shape_error:{type(e).__name__}"
+
+    def _count_plan_solo(self, reason: str) -> None:
+        """A pool-eligible plan job fell back to the solo engine: count
+        it (stats pool.plan ``plan_solo_fallbacks`` + the closed obs
+        registry) and log once per distinct reason — the fused_demoted
+        stance: an operator watching a 2-worker pool buy nothing for
+        their pipeline finds out WHY, not never."""
+        with self._lock:
+            self._plan_counters["plan_solo_fallbacks"] += 1
+            first = reason not in self._plan_solo_logged
+            self._plan_solo_logged.add(reason)
+        obs.metric_inc("plan.solo_fallbacks")
+        if first:
+            logger.warning(
+                "plan job demoted to the solo engine (%s); further "
+                "demotions for this reason are counted, not logged "
+                "(stats pool.plan plan_solo_fallbacks)", reason,
+            )
 
     def _plan_distributable(self, job: Job) -> bool:
         """Large plan jobs whose DAG matches a covered shape fan their
         stages across the pool; everything else keeps the solo engine —
         the floor, and the byte-identity anchor the distributed path is
-        measured against (docs/PLAN.md "Distributed execution")."""
-        return (
-            self.pool is not None
-            and job.spec.plan is not None
-            and self.cfg.shard_max >= 2
-            and job.n_blocks >= self.cfg.shard_min_blocks
-            and self._plan_shape(job) is not None
-        )
+        measured against (docs/PLAN.md "Distributed execution").  A
+        pool-eligible job that fails ONLY the shape check is a counted,
+        logged demotion (never silent)."""
+        if (
+            self.pool is None
+            or job.spec.plan is None
+            or self.cfg.shard_max < 2
+            or job.n_blocks < self.cfg.shard_min_blocks
+        ):
+            return False
+        shape, reason = self._plan_shape(job)
+        if shape is None:
+            self._count_plan_solo(reason or "unrecognized_shape")
+            return False
+        return True
 
     def _dispatch_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -1775,13 +1842,35 @@ class ServeDaemon:
         """Fan one covered-shape plan across the pool as stage programs
         (docs/PLAN.md "Distributed execution").
 
-        Map wave: each contiguous block-aligned source split folds on a
-        worker's warm executables and publishes its shuffle partitions
+        Fold spines (StageShape) — map wave: each contiguous
+        block-aligned source split folds on a worker's warm fold-node
+        executables (cache.fold_node_key: a repeat plan skips the
+        per-worker recompile) and publishes its shuffle partitions
         atomically into the content-addressed spill.  Reduce wave: each
         partition's inputs move worker-to-worker over the binary data
         plane and combine on the reducing worker.  Finalize folds the
         reduced partitions into the solo renderer's EXACT bytes on the
         daemon — byte-identity to the solo engine is the contract.
+
+        Join trees (JoinShape) run the SAME map wave once (every leaf
+        is the one corpus wordcount fold) and then a join wave: each
+        co-partitioned bin merges its inputs and evaluates the WHOLE
+        tree locally, however deep — chained per-worker stage programs,
+        no master round-trip between joins.  Two explicit identity
+        gates demote to solo (counted, logged): any truncated/overflow
+        map split, or total distinct past the solo fold's table
+        capacity — outside both, the solo leaves are provably exact and
+        the host merge reproduces them bit-for-bit.
+
+        Pagerank (IterateShape) runs as epoch-synchronized sweeps: each
+        worker owns a contiguous rank shard, computes one bit-exact
+        ``pagerank_step`` per epoch over its dst-restricted edge subset
+        and publishes its slice; the next epoch's stages reconstruct
+        the full vector from ALL shards' partitions (the one shuffle
+        per iteration).  Completed epochs journal as WAL stage records,
+        so a SIGKILL mid-iteration resumes from the last fully-intact
+        epoch's partitions; a lost shard partition recomputes exactly
+        that (epoch, shard) stage.
 
         Robustness is STAGE-granular: a failed/dead worker's stage
         recomputes on a survivor from its durable inputs (never a
@@ -1796,34 +1885,54 @@ class ServeDaemon:
         (or any unrecognized shape upstream) = the solo floor.
         """
         from locust_tpu.plan import distribute
+        from locust_tpu.plan.compile import (
+            SERVE_MAX_PAGERANK_NODES, edges_from_bytes,
+        )
         from locust_tpu.serve import pool as pool_mod
 
-        shape = self._plan_shape(job)
+        shape, shape_reason = self._plan_shape(job)
         cfg = job.spec.cfg
         corpus = corpora.get(job.corpus_digest, b"")
         plan_fp = job.spec.plan_fingerprint()
-        ranges = pool_mod.shard_ranges(
-            job.n_lines, cfg.block_lines, self.cfg.shard_max
-        )
         placements: list = []
         used: set[int] = set()
         part_files: set[str] = set()
         try:
-            akey = self._affinity_key(job)
-            if shape is not None and len(ranges) >= 2:
-                for _ in ranges:
+            if shape is None:
+                raise _PlanSolo(shape_reason or "unrecognized_shape")
+            is_iter = isinstance(shape, distribute.IterateShape)
+            is_join = isinstance(shape, distribute.JoinShape)
+            ranges: list = []
+            num_nodes = 0
+            if is_iter:
+                if shape.num_iters < 1:
+                    # Zero sweeps = ranks0; no epoch partitions would
+                    # exist to finalize from — the solo scan owns it.
+                    raise _PlanSolo("iterate_no_epochs")
+                # The edge list names the dense node space (PlanError
+                # here = the same bad_spec the solo evaluator answers).
+                src, dst = edges_from_bytes(corpus)
+                num_nodes = int(max(int(src.max()), int(dst.max()))) + 1
+                if num_nodes > SERVE_MAX_PAGERANK_NODES:
+                    # The solo path raises the canonical bad_spec text.
+                    raise _PlanSolo("pagerank_node_cap")
+                n_tasks = min(self.cfg.shard_max, num_nodes)
+            else:
+                ranges = pool_mod.shard_ranges(
+                    job.n_lines, cfg.block_lines, self.cfg.shard_max
+                )
+                n_tasks = len(ranges)
+            akey = self._plan_affinity_key(job, shape)
+            if n_tasks >= 2:
+                for _ in range(n_tasks):
                     w = self.pool.place(akey, exclude=used)
                     if w is None:
                         break
                     used.add(w.idx)
                     placements.append(w)
             if len(placements) < 2:
-                for w in placements:
-                    self.pool.release(w)
-                placements = []
-                self._dispatch_local([job], corpora)
-                return
-            if len(placements) < len(ranges):
+                raise _PlanSolo("insufficient_workers")
+            if not is_iter and len(placements) < len(ranges):
                 # Same reconciliation as sharding: re-derive the splits
                 # for the workers we actually hold — never drop lines.
                 ranges = pool_mod.shard_ranges(
@@ -1834,7 +1943,7 @@ class ServeDaemon:
                 placements = placements[: len(ranges)]
             n_splits = len(ranges)
             n_parts = len(placements)
-            job.shards = n_splits
+            job.shards = n_parts if is_iter else n_splits
             job.placed_on = "plan:" + ",".join(w.name for w in placements)
             self.pool.spill(job.corpus_digest, corpus)
             dead: set[int] = set()
@@ -1849,66 +1958,11 @@ class ServeDaemon:
                         return w
                 return None
 
-            def build_map_req(split: int, attempt: int) -> dict:
-                a, b = ranges[split]
-                return {
-                    "phase": "map", "fold": shape.fold,
-                    "config": job.config_overrides or {},
-                    "sha": job.corpus_digest,
-                    "spill_dir": self.pool.spill_dir,
-                    "plan_fp": plan_fp, "split": split,
-                    "attempt": attempt, "n_parts": n_parts,
-                    "line_start": a, "line_end": b,
-                    "lines_per_doc": shape.lines_per_doc,
-                }
-
-            map_done: dict[int, dict] = {}
-
-            def journal_stage(split: int, reply: dict) -> None:
-                if self.journal is not None:
-                    self.journal.append_stage(job.job_id, {
-                        "split": split,
-                        "attempt": int(reply.get("attempt", 0)),
-                        "worker": reply.get("worker", ""),
-                        "n_parts": n_parts,
-                        "truncated": bool(reply.get("truncated")),
-                        "overflow_tokens": int(
-                            reply.get("overflow_tokens", 0)
-                        ),
-                        "parts": reply.get("parts", []),
-                    })
-
-            # WAL-replayed stage progress: reuse a completed split when
-            # the partition layout matches and every file survived with
-            # its recorded sha — a restart RESUMES the plan instead of
-            # remapping everything (anything damaged just recomputes).
+            # WAL-replayed stage progress (map split or iterate epoch
+            # records — they self-discriminate by key): popped once, the
+            # shape branch below decides what resumes.
             with self._lock:
                 progress = self._plan_progress.pop(job.job_id, [])
-            for st in progress:
-                try:
-                    s = int(st.get("split", -1))
-                    parts = list(st.get("parts") or [])
-                    if (not 0 <= s < n_splits or s in map_done
-                            or int(st.get("n_parts", -1)) != n_parts
-                            or len(parts) != n_parts):
-                        continue
-                    for ref in parts:
-                        with open(str(ref["path"]), "rb") as f:
-                            data = f.read()
-                        if (hashlib.sha256(data).hexdigest()
-                                != ref["sha256"]):
-                            raise ValueError("partition sha drifted")
-                except Exception as e:  # noqa: BLE001 - damaged = recompute
-                    logger.warning(
-                        "plan resume: damaged stage record skipped "
-                        "(%s: %s); that split recomputes",
-                        type(e).__name__, e,
-                    )
-                    continue
-                map_done[s] = dict(st)
-                part_files.update(str(p["path"]) for p in parts)
-                with self._lock:
-                    self._plan_counters["partitions_reused"] += n_parts
 
             def run_wave(phase, task_ids, build_req, repair=None,
                          on_win=None):
@@ -1959,7 +2013,16 @@ class ServeDaemon:
                                 raise  # the outer fence handler owns it
                             if task in won:
                                 continue  # a speculative loser died
-                            dead.add(w.idx)
+                            if (getattr(e, "lost_split", None) is None
+                                    and getattr(e, "lost_epoch", None)
+                                    is None):
+                                # Transport-level death.  A structured
+                                # loss report is the ANSWERING worker
+                                # doing its job (a dead peer's partition
+                                # is the casualty) — marking it dead too
+                                # would strand a 2-worker pool with one
+                                # real death on the solo floor.
+                                dead.add(w.idx)
                             if attempts[task] >= 3 \
                                     or next_worker() is None:
                                 raise
@@ -1974,6 +2037,12 @@ class ServeDaemon:
                             part_files.update(
                                 str(p["path"]) for p in reply["parts"]
                             )
+                        ref = reply.get("ref")
+                        if isinstance(ref, dict) and ref.get("path"):
+                            # Iterate replies publish ONE shard slice —
+                            # tracked even for speculative losers so no
+                            # epoch partition outlives the job.
+                            part_files.add(str(ref["path"]))
                         if task in won:
                             continue  # first finisher already won
                         won[task] = reply
@@ -1995,9 +2064,250 @@ class ServeDaemon:
                         launch(t)
                 return won
 
+            if is_iter:
+                # ---- pagerank: epoch-synchronized rank-shard sweeps --
+                n_shards = n_parts
+                epoch_refs: dict[int, dict[int, dict]] = {}
+
+                def journal_epoch(epoch: int, refs: dict) -> None:
+                    if self.journal is not None:
+                        self.journal.append_stage(job.job_id, {
+                            "epoch": epoch,
+                            "n_shards": n_shards,
+                            "parts": [refs[s] for s in range(n_shards)],
+                        })
+
+                # WAL-replayed epoch progress: resume from the HIGHEST
+                # fully-intact journaled epoch (every shard slice present
+                # with its recorded sha) — a daemon restart re-runs only
+                # the sweeps past it, byte-identically (each epoch is a
+                # pure function of the previous epoch's partitions).
+                best = 0
+                best_refs: dict[int, dict] = {}
+                for st in progress:
+                    try:
+                        e_no = int(st.get("epoch", -1))
+                        parts = list(st.get("parts") or [])
+                        if (e_no <= best or e_no > shape.num_iters
+                                or int(st.get("n_shards", -1)) != n_shards
+                                or len(parts) != n_shards):
+                            continue
+                        for ref in parts:
+                            with open(str(ref["path"]), "rb") as f:
+                                data = f.read()
+                            if (hashlib.sha256(data).hexdigest()
+                                    != ref["sha256"]):
+                                raise ValueError("partition sha drifted")
+                        best = e_no
+                        best_refs = {
+                            int(r["part"]): dict(r) for r in parts
+                        }
+                    except Exception as e:  # noqa: BLE001 - damaged = recompute
+                        logger.warning(
+                            "plan resume: damaged epoch record skipped "
+                            "(%s: %s); that epoch recomputes",
+                            type(e).__name__, e,
+                        )
+                        continue
+                if best:
+                    epoch_refs[best] = best_refs
+                    part_files.update(
+                        str(r["path"]) for r in best_refs.values()
+                    )
+                    with self._lock:
+                        self._plan_counters["partitions_reused"] += (
+                            n_shards
+                        )
+
+                def inputs_for(epoch: int):
+                    """The previous epoch's full partition set (None =
+                    the uniform-ranks first sweep).  Read at BUILD time
+                    so a mid-wave repair's fresh refs reach relaunched
+                    and speculative attempts."""
+                    if epoch < 1:
+                        return None
+                    refs = epoch_refs[epoch]
+                    return [dict(refs[s]) for s in range(n_shards)]
+
+                def build_iter_req(epoch: int):
+                    def build(shard: int, attempt: int) -> dict:
+                        return {
+                            "phase": "iterate",
+                            "sha": job.corpus_digest,
+                            "spill_dir": self.pool.spill_dir,
+                            "plan_fp": plan_fp,
+                            "epoch": epoch, "shard": shard,
+                            "n_shards": n_shards,
+                            "num_nodes": num_nodes,
+                            "damping": shape.damping,
+                            "attempt": attempt,
+                            "inputs": inputs_for(epoch - 1),
+                            # split/part feed the chaos + obs stage ctx.
+                            "split": epoch, "part": shard,
+                        }
+                    return build
+
+                def repair_iterate(epoch: int):
+                    def repair(shard: int, exc) -> None:
+                        """A sweep lost one of the PREVIOUS epoch's
+                        shard slices: recompute exactly that
+                        (epoch-1, shard) stage on a survivor and
+                        re-journal — the relaunched sweep reads the
+                        fresh ref through inputs_for's closure.  The
+                        recompute is deterministic, so the re-journaled
+                        epoch is bit-identical to the original."""
+                        le = getattr(exc, "lost_epoch", None)
+                        ls = getattr(exc, "lost_split", None)
+                        if le is None or ls is None:
+                            return
+                        le, ls = int(le), int(ls)
+                        if le != epoch - 1 or le < 1:
+                            return
+                        w = next_worker()
+                        if w is None:
+                            raise PoolDispatchError(
+                                "no surviving plan-stage workers"
+                            )
+                        old = epoch_refs[le][ls]
+                        att = int(old.get("attempt", 0)) + 1
+                        reply = self._run_plan_stage_rpc(
+                            w, build_iter_req(le)(ls, att), "iterate"
+                        )
+                        ref = dict(
+                            reply["ref"],
+                            worker=reply.get("worker", ""),
+                            attempt=att,
+                        )
+                        epoch_refs[le][ls] = ref
+                        part_files.add(str(ref["path"]))
+                        journal_epoch(le, epoch_refs[le])
+                    return repair
+
+                for epoch in range(best + 1, shape.num_iters + 1):
+                    won = run_wave(
+                        "iterate", list(range(n_shards)),
+                        build_iter_req(epoch),
+                        repair=repair_iterate(epoch),
+                    )
+                    refs = {}
+                    for shard, reply in won.items():
+                        refs[int(reply.get("shard", shard))] = dict(
+                            reply["ref"],
+                            worker=reply.get("worker", ""),
+                            attempt=int(reply.get("attempt", 0)),
+                        )
+                    epoch_refs[epoch] = refs
+                    journal_epoch(epoch, refs)
+                    # The rank-shuffle chaos window: published slices
+                    # sit durable between epochs, same exposure as the
+                    # fold shuffle's map->reduce gap.
+                    for s in range(n_shards):
+                        distribute.chaos_partition(
+                            str(refs[s]["path"]), epoch, s
+                        )
+                # Finalize on the host: the final epoch's shard slices
+                # concatenate (shard order IS node order) into the solo
+                # renderer's exact bytes — pure numpy, no engine lock.
+                final = epoch_refs[shape.num_iters]
+                slices = []
+                for s in range(n_shards):
+                    ref = final[s]
+                    pairs = distribute.read_partition(
+                        str(ref["path"]), str(ref["sha256"]),
+                        distribute.RANK_KEY_WIDTH,
+                    )
+                    slices.append(distribute.decode_rank_values(pairs))
+                output, distinct, trunc, ovf = (
+                    distribute.finalize_ranks(slices)
+                )
+                self._finish_job(
+                    job, [(output, 0)], distinct, trunc, ovf,
+                    "distributed", time.monotonic(),
+                )
+                return
+
+            # ---- fold spines + join trees: one shared map wave ------
+            # Every leaf of a covered join tree is the SAME corpus
+            # wordcount fold, so ONE map wave serves however many
+            # leaves the tree has.
+            fold = "wordcount" if is_join else shape.fold
+            map_node_fp = (
+                shape.leaves[0].node_fp if is_join else shape.node_fp
+            )
+            lines_per_doc = 1 if is_join else shape.lines_per_doc
+
+            def build_map_req(split: int, attempt: int) -> dict:
+                a, b = ranges[split]
+                return {
+                    "phase": "map", "fold": fold,
+                    "config": job.config_overrides or {},
+                    "sha": job.corpus_digest,
+                    "spill_dir": self.pool.spill_dir,
+                    "plan_fp": plan_fp, "split": split,
+                    "attempt": attempt, "n_parts": n_parts,
+                    "line_start": a, "line_end": b,
+                    "lines_per_doc": lines_per_doc,
+                    # Keys the worker's warm fold-node executables: a
+                    # repeat plan skips the per-worker recompile.
+                    "node_fp": map_node_fp,
+                }
+
+            map_done: dict[int, dict] = {}
+
+            def journal_stage(split: int, reply: dict) -> None:
+                if self.journal is not None:
+                    self.journal.append_stage(job.job_id, {
+                        "split": split,
+                        "attempt": int(reply.get("attempt", 0)),
+                        "worker": reply.get("worker", ""),
+                        "n_parts": n_parts,
+                        "truncated": bool(reply.get("truncated")),
+                        "overflow_tokens": int(
+                            reply.get("overflow_tokens", 0)
+                        ),
+                        "parts": reply.get("parts", []),
+                    })
+
+            # Reuse a WAL-replayed completed split when the partition
+            # layout matches and every file survived with its recorded
+            # sha — a restart RESUMES the plan instead of remapping
+            # everything (anything damaged just recomputes).
+            for st in progress:
+                try:
+                    s = int(st.get("split", -1))
+                    parts = list(st.get("parts") or [])
+                    if (not 0 <= s < n_splits or s in map_done
+                            or int(st.get("n_parts", -1)) != n_parts
+                            or len(parts) != n_parts):
+                        continue
+                    for ref in parts:
+                        with open(str(ref["path"]), "rb") as f:
+                            data = f.read()
+                        if (hashlib.sha256(data).hexdigest()
+                                != ref["sha256"]):
+                            raise ValueError("partition sha drifted")
+                except Exception as e:  # noqa: BLE001 - damaged = recompute
+                    logger.warning(
+                        "plan resume: damaged stage record skipped "
+                        "(%s: %s); that split recomputes",
+                        type(e).__name__, e,
+                    )
+                    continue
+                map_done[s] = dict(st)
+                part_files.update(str(p["path"]) for p in parts)
+                with self._lock:
+                    self._plan_counters["partitions_reused"] += n_parts
+
             def on_map_win(split, reply, w):
                 journal_stage(split, reply)
                 self.pool.mark_warm(w, akey)
+                if reply.get("warm"):
+                    # The worker folded on an already-compiled fold-node
+                    # executable (the warm-repeat economics, test- and
+                    # bench-pinned: compiles stay flat on resubmit).
+                    with self._lock:
+                        self._plan_counters["map_warm_hits"] += 1
+                    obs.metric_inc("plan.map_warm_hits")
 
             todo = [s for s in range(n_splits) if s not in map_done]
             if todo:
@@ -2019,27 +2329,24 @@ class ServeDaemon:
                     distribute.chaos_partition(
                         str(ref["path"]), s, int(ref["part"])
                     )
-            key_width = distribute.partition_key_width(cfg, shape.fold)
+            key_width = distribute.partition_key_width(cfg, fold)
 
-            def build_reduce_req(part: int, attempt: int) -> dict:
-                return {
-                    "phase": "reduce", "part": part,
-                    "key_width": key_width,
-                    "attempt": attempt,
-                    "inputs": [
-                        dict(
-                            map_done[s]["parts"][part], split=s,
-                            worker=map_done[s].get("worker", ""),
-                        )
-                        for s in range(n_splits)
-                    ],
-                }
+            def partition_inputs(part: int) -> list:
+                """One bin's per-split input refs, read at BUILD time so
+                a mid-wave repair's fresh refs reach relaunches."""
+                return [
+                    dict(
+                        map_done[s]["parts"][part], split=s,
+                        worker=map_done[s].get("worker", ""),
+                    )
+                    for s in range(n_splits)
+                ]
 
-            def repair_reduce(part: int, exc) -> None:
-                """A reduce attempt lost a partition input: recompute
-                exactly that map split (attempt-bumped, on a survivor)
-                and re-journal it — the relaunched reduce reads the
-                fresh refs through build_reduce_req's closure."""
+            def repair_map_input(part: int, exc) -> None:
+                """A reduce/join attempt lost a partition input:
+                recompute exactly that map split (attempt-bumped, on a
+                survivor) and re-journal it — the relaunched stage reads
+                the fresh refs through partition_inputs' closure."""
                 s = getattr(exc, "lost_split", None)
                 if s is None:
                     return
@@ -2059,9 +2366,63 @@ class ServeDaemon:
                 map_done[s] = reply
                 journal_stage(s, reply)
 
+            if is_join:
+                # ---- join wave: per-bin hash-join, tree-deep ---------
+                # Identity gate 1: the solo leaves must be provably
+                # untruncated (a truncated fold's table is not the exact
+                # wordcount the solo join reads).
+                if truncated or overflow:
+                    raise _PlanSolo("join_fold_truncated")
+                tree_wire = distribute.tree_doc(shape.tree)
+
+                def build_join_req(part: int, attempt: int) -> dict:
+                    return {
+                        "phase": "join", "part": part,
+                        "key_width": key_width,
+                        "attempt": attempt,
+                        "tree": tree_wire,
+                        "inputs": partition_inputs(part),
+                    }
+
+                join_done = run_wave(
+                    "join", list(range(n_parts)), build_join_req,
+                    repair=repair_map_input,
+                )
+                # Identity gate 2: total distinct within the solo
+                # fold's table capacity — past it the solo engine WOULD
+                # have truncated, so the solo path must answer.
+                total_distinct = sum(
+                    int(join_done[p].get("distinct", 0))
+                    for p in range(n_parts)
+                )
+                if total_distinct > cfg.resolved_table_size:
+                    raise _PlanSolo("join_fold_capacity")
+                # Host-side merge on purpose: join values are unbounded
+                # Python ints (mul combines) — no engine lock needed.
+                output, distinct, trunc, ovf = distribute.finalize_join([
+                    [
+                        (base64.b64decode(k), int(v))
+                        for k, v in join_done[p].get("pairs", [])
+                    ]
+                    for p in range(n_parts)
+                ])
+                self._finish_job(
+                    job, [(output, 0)], distinct, trunc, ovf,
+                    "distributed", time.monotonic(),
+                )
+                return
+
+            def build_reduce_req(part: int, attempt: int) -> dict:
+                return {
+                    "phase": "reduce", "part": part,
+                    "key_width": key_width,
+                    "attempt": attempt,
+                    "inputs": partition_inputs(part),
+                }
+
             reduce_done = run_wave(
                 "reduce", list(range(n_parts)), build_reduce_req,
-                repair=repair_reduce,
+                repair=repair_map_input,
             )
             partition_pairs = [
                 [
@@ -2082,6 +2443,16 @@ class ServeDaemon:
                 job, [(output, 0)], distinct, trunc, ovf,
                 "distributed", time.monotonic(),
             )
+        except _PlanSolo as e:
+            # The solo engine is the correctness floor: demote LOUDLY
+            # (logged once per reason, counted in stats pool.plan —
+            # never silent, the fused_demoted stance).  Placements go
+            # back first so the solo run never starves the pool.
+            for w in placements:
+                self.pool.release(w)
+            placements = []
+            self._count_plan_solo(e.reason)
+            self._dispatch_local([job], corpora)
         except PlanError as e:
             # Deterministic rejection — same bad_spec discipline as the
             # solo plan path (retrying cannot change the answer).

@@ -67,13 +67,19 @@ class PoolDispatchError(RuntimeError):
     primary must react to, not merely retry (docs/SERVING.md)."""
 
     def __init__(self, message: str, code: str | None = None,
-                 epoch: int | None = None, lost_split: int | None = None):
+                 epoch: int | None = None, lost_split: int | None = None,
+                 lost_epoch: int | None = None):
         self.code = code
         self.epoch = epoch  # the rejecting side's fencing epoch, if sent
         # A reduce stage naming the map split whose partition input it
         # lost: the plan coordinator recomputes exactly that split
         # (docs/PLAN.md "Distributed execution"), not the whole plan.
         self.lost_split = lost_split
+        # An iterate sweep naming the EPOCH whose shard partition it
+        # lost (lost_split then names the shard): the coordinator
+        # recomputes that (epoch, shard) stage from the epoch before
+        # it, not the whole iteration history.
+        self.lost_epoch = lost_epoch
         super().__init__(message)
 
 
@@ -456,13 +462,14 @@ class WorkerPool:
                 worker, f"answered: {reply.get('error')}",
                 code=reply.get("code"), epoch=reply.get("epoch"),
                 lost_split=reply.get("lost_split"),
+                lost_epoch=reply.get("lost_epoch"),
             )
         self.health.ok(worker.idx)
         return reply
 
     def _dispatch_failed(
         self, worker: PoolWorker, msg: str, cause=None, code=None,
-        epoch=None, lost_split=None,
+        epoch=None, lost_split=None, lost_epoch=None,
     ):
         """The ONE failure path out of ``dispatch``: quarantine the
         worker, count it, raise for the caller's retry ladder."""
@@ -474,6 +481,7 @@ class WorkerPool:
             code=str(code) if code else None,
             epoch=int(epoch) if epoch is not None else None,
             lost_split=int(lost_split) if lost_split is not None else None,
+            lost_epoch=int(lost_epoch) if lost_epoch is not None else None,
         )
         if cause is not None:
             raise err from cause

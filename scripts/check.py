@@ -635,7 +635,24 @@ try:
          corpus_path, "--plan", plan_path, "--port", port] + cfg_flags,
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
-    time.sleep(1.5)
+    # Kill w2 only once the map wave is provably in flight (w2's split
+    # is held open by its fault plan while w1's lands) — a blind sleep
+    # races a slow admit and can kill w2 BEFORE placement, demoting the
+    # job to solo instead of exercising the mid-stage recompute.
+    from locust_tpu.serve.client import ServeClient
+    client = ServeClient((host, int(port)), b"dplan-smoke", timeout=60.0)
+    deadline = time.time() + 120.0
+    while time.time() < deadline:
+        try:
+            pl = client.stats()["pool"]["plan"]
+        except Exception:
+            pl = {}
+        if pl.get("stages", 0) >= 1:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("map wave never started: %r" % (pl,))
+    time.sleep(0.5)
     w2.send_signal(signal.SIGKILL)
     w2.wait(timeout=10)
     out, err = submit.communicate(timeout=240)
@@ -644,8 +661,6 @@ try:
         "distributed plan != one-shot tfidf CLI\\n%r\\n%r"
         % (out[:200], one_shot.stdout[:200])
     )
-    from locust_tpu.serve.client import ServeClient
-    client = ServeClient((host, int(port)), b"dplan-smoke", timeout=60.0)
     pl = client.stats()["pool"]["plan"]
     assert pl["stages"] >= 4, pl      # it really ran distributed
     assert pl["recomputes"] >= 1, pl  # and really lost a stage
@@ -658,6 +673,134 @@ finally:
 print("[check] dplan smoke ok (tfidf plan across 2 real workers; "
       "SIGKILL mid-map-stage -> survivor recompute, byte-identical "
       "to the one-shot CLI)", file=sys.stderr)
+
+# ---- Plan surface v2 drills: SIGKILL mid-JOIN-stage and mid-pagerank-
+# EPOCH.  Oracle = the same plan submitted to a solo (poolless) daemon;
+# the distributed answer must be byte-identical even with a worker
+# killed while its stage is provably in flight (the fault plan holds
+# that stage open, and the kill lands inside the hold).
+from locust_tpu.plan import pagerank_plan
+from locust_tpu.plan.nodes import Plan, node
+from locust_tpu.serve.client import ServeClient
+
+join_doc = Plan((
+    node("c1", "source", "text"),
+    node("m1", "map", "tokenize_count", ("c1",)),
+    node("s1", "shuffle", "by_key", ("m1",)),
+    node("r1", "reduce", "sum", ("s1",)),
+    node("c2", "source", "text"),
+    node("m2", "map", "tokenize_count", ("c2",)),
+    node("s2", "shuffle", "by_key", ("m2",)),
+    node("r2", "reduce", "sum", ("s2",)),
+    node("j1", "join", "inner", ("r1", "r2"), combine="mul"),
+    node("out", "sink", "table", ("j1",)),
+)).to_doc()
+join_path = os.path.join(td, "join_plan.json")
+pr_path = os.path.join(td, "pr_plan.json")
+edges_path = os.path.join(td, "edges.txt")
+with open(join_path, "w") as f:
+    json.dump(join_doc, f)
+with open(pr_path, "w") as f:
+    json.dump(pagerank_plan(4).to_doc(), f)
+with open(edges_path, "wb") as f:
+    f.write(b"0 1\\n1 2\\n2 0\\n0 2\\n3 1\\n2 3\\n" * 3)
+
+def spawn_daemon(workers=None):
+    cmd = [sys.executable, "-m", "locust_tpu.serve", "--port", "0"]
+    if workers:
+        cmd += ["--workers", ",".join(workers),
+                "--shard-min-blocks", "1"]
+    proc = subprocess.Popen(cmd, env=env, stderr=subprocess.PIPE,
+                            text=True)
+    line = proc.stderr.readline()
+    assert "listening on" in line, line
+    host, _, port = line.rsplit(" ", 1)[1].strip().partition(":")
+    return proc, host, port
+
+def submit(port, corpus, plan_path, background=False):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "locust_tpu.serve", "submit",
+         corpus, "--plan", plan_path, "--port", port] + cfg_flags,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    if background:
+        return p
+    out, err = p.communicate(timeout=240)
+    assert p.returncode == 0, err[-800:]
+    return out
+
+sd, _, sport = spawn_daemon()
+try:
+    oracle_join = submit(sport, corpus_path, join_path)
+    oracle_pr = submit(sport, edges_path, pr_path)
+    subprocess.run(
+        [sys.executable, "-m", "locust_tpu.serve", "shutdown",
+         "--port", sport],
+        env=env, capture_output=True, timeout=60,
+    )
+    sd.wait(timeout=30)
+finally:
+    if sd.poll() is None:
+        sd.kill()
+
+def drill(plan_path, corpus, phase, oracle, kill_after_stages,
+          min_stages, match=None):
+    wa, aa = spawn_worker()
+    wb, ab = spawn_worker(fault={"seed": 7, "rules": [
+        {"site": "plan.stage", "action": "delay", "delay_s": 8.0,
+         "match": match or {"phase": phase}, "times": 1}]})
+    dproc, host, port = spawn_daemon([aa, ab])
+    try:
+        sub = submit(port, corpus, plan_path, background=True)
+        client = ServeClient((host, int(port)), b"dplan-smoke",
+                             timeout=60.0)
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            try:
+                pl = client.stats()["pool"]["plan"]
+            except Exception:
+                pl = {}
+            if pl.get("stages", 0) >= kill_after_stages:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("%s drill never reached %d stages"
+                                 % (phase, kill_after_stages))
+        time.sleep(0.5)  # the held stage is now in flight on wb
+        wb.send_signal(signal.SIGKILL)
+        wb.wait(timeout=10)
+        out, err = sub.communicate(timeout=240)
+        assert sub.returncode == 0, (phase, err[-800:])
+        assert out == oracle, (
+            "distributed %s plan != solo daemon\\n%r\\n%r"
+            % (phase, out[:200], oracle[:200])
+        )
+        pl = client.stats()["pool"]["plan"]
+        assert pl["stages"] >= min_stages, (phase, pl)
+        assert pl["recomputes"] >= 1, (phase, pl)
+        assert pl["plan_solo_fallbacks"] == 0, (phase, pl)
+        client.shutdown()
+        dproc.wait(timeout=60)
+    finally:
+        for p in (wa, wb, dproc):
+            if p.poll() is None:
+                p.kill()
+
+# Join: the map wave (2 splits) completes, then wb's join stage is held
+# open 8s — the SIGKILL lands mid-join-bin and the survivor re-joins
+# that bin from the durable leaf partitions.
+drill(join_path, corpus_path, "join", oracle_join,
+      kill_after_stages=2, min_stages=4)
+# Iterate: epoch 1 (2 rank shards) completes and journals, then wb's
+# epoch-2 sweep is held open — the SIGKILL lands mid-epoch and the
+# survivor recomputes that rank shard from epoch 1's partitions.
+drill(pr_path, edges_path, "iterate", oracle_pr,
+      kill_after_stages=2, min_stages=6,
+      match={"phase": "iterate", "split": 2})
+print("[check] dplan smoke ok (join tree + pagerank plans across 2 "
+      "real workers; SIGKILL mid-join-stage and mid-pagerank-epoch -> "
+      "survivor recompute, byte-identical to the solo daemon)",
+      file=sys.stderr)
 """
 
 

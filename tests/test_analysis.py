@@ -1279,8 +1279,21 @@ _FIXTURE_PLAN_NODES = """
 """
 
 
+_FIXTURE_PLAN_DISTRIBUTE = """
+    SOLO_ONLY = ()
+
+    def shape(n):
+        if n.kind == "source":
+            return "dist-source"
+        if n.kind == "sink":
+            return "dist-sink"
+        return None
+"""
+
+
 def _r014_tree(tmp_path, compile_src=None, nodes=_FIXTURE_PLAN_NODES,
-               docs_text=None, tests_text=None):
+               docs_text=None, tests_text=None,
+               distribute_src=_FIXTURE_PLAN_DISTRIBUTE):
     _write(tmp_path, "locust_tpu/plan/nodes.py", nodes)
     _write(
         tmp_path, "locust_tpu/plan/compile.py",
@@ -1292,6 +1305,7 @@ def _r014_tree(tmp_path, compile_src=None, nodes=_FIXTURE_PLAN_NODES,
                 return "stage-sink"
             raise ValueError(n.kind)
     """)
+    _write(tmp_path, "locust_tpu/plan/distribute.py", distribute_src)
     _write(tmp_path, "tests/test_plan.py",
            tests_text if tests_text is not None
            else '# exercises "source" and "sink"\n')
@@ -1363,8 +1377,9 @@ def test_r014_fires_on_uncompiled_untested_undocumented_kind(tmp_path):
     assert "never lowered" in msgs
     assert "never exercised" in msgs
     assert "undocumented" in msgs
+    assert "neither matched" in msgs  # the distribute-coverage side
     assert all("window" in f.message for f in res.new)
-    assert len(res.new) == 3
+    assert len(res.new) == 4
 
 
 def test_r014_analyzer_suite_quotes_do_not_count_as_coverage(tmp_path):
@@ -1388,6 +1403,9 @@ def test_r014_analyzer_suite_quotes_do_not_count_as_coverage(tmp_path):
             raise ValueError(n.kind)
     """,
         docs_text="| `source` | `sink` | `window` |\n",
+        distribute_src=_FIXTURE_PLAN_DISTRIBUTE.replace(
+            '"sink":', '"window" or n.kind == "sink":'
+        ),
     )
     _write(tmp_path, "tests/test_analysis.py",
            '# quotes "window" in a rule fixture, not a plan test\n')
@@ -1413,6 +1431,7 @@ def test_r014_mutating_real_node_kinds_fails_the_gate(tmp_path):
     for rel in (
         "locust_tpu/plan/nodes.py",
         "locust_tpu/plan/compile.py",
+        "locust_tpu/plan/distribute.py",
         "locust_tpu/plan/builders.py",
         "tests/test_plan.py",
         "docs/PLAN.md",
@@ -1431,8 +1450,68 @@ def test_r014_mutating_real_node_kinds_fails_the_gate(tmp_path):
     assert '"window"' in mutated
     np_.write_text(mutated)
     res = _run(tmp_path, ["R014"], paths)
-    assert len(res.new) == 3  # unlowered + untested + undocumented
+    # unlowered + untested + undocumented + undistributed
+    assert len(res.new) == 4
     assert all("window" in f.message for f in res.new)
+
+
+def test_r014_solo_only_registry_covers_an_unmatched_kind(tmp_path):
+    """The distribute-coverage escape hatch: a kind distribute.py never
+    matches is green IF (and only if) it sits in SOLO_ONLY."""
+    nodes = _FIXTURE_PLAN_NODES.replace(
+        '"source",', '"source",\n        "window",'
+    )
+    compile_src = """
+        def lower(n):
+            if n.kind == "source":
+                return "s"
+            if n.kind == "sink":
+                return "k"
+            if n.kind == "window":
+                return "w"
+            raise ValueError(n.kind)
+    """
+    kw = dict(
+        nodes=nodes, compile_src=compile_src,
+        docs_text="| `source` | `sink` | `window` |\n",
+        tests_text='# exercises "source", "sink" and "window"\n',
+    )
+    _r014_tree(tmp_path, **kw)
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "neither matched" in res.new[0].message
+    assert "window" in res.new[0].message
+    _r014_tree(tmp_path, distribute_src=_FIXTURE_PLAN_DISTRIBUTE.replace(
+        "SOLO_ONLY = ()", 'SOLO_ONLY = ("window",)'
+    ), **kw)
+    assert not _run(tmp_path, ["R014"], ["locust_tpu", "tests"]).new
+
+
+def test_r014_fires_on_stale_and_unknown_solo_only_entries(tmp_path):
+    # Stale: "sink" is exempted AND matched in distribute.py.  Unknown:
+    # "ghost" is not a NODE_KINDS entry at all.
+    _r014_tree(tmp_path, distribute_src=_FIXTURE_PLAN_DISTRIBUTE.replace(
+        "SOLO_ONLY = ()", 'SOLO_ONLY = ("sink", "ghost")'
+    ))
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    msgs = " | ".join(f.message for f in res.new)
+    assert len(res.new) == 2
+    assert "stale" in msgs and "sink" in msgs
+    assert "ghost" in msgs and "not a NODE_KINDS entry" in msgs
+
+
+def test_r014_missing_solo_only_registry_reports_once(tmp_path):
+    _r014_tree(tmp_path, distribute_src="""
+        def shape(n):
+            if n.kind == "source":
+                return "dist-source"
+            if n.kind == "sink":
+                return "dist-sink"
+            return None
+    """)
+    res = _run(tmp_path, ["R014"], ["locust_tpu", "tests"])
+    assert len(res.new) == 1
+    assert "cannot parse the SOLO_ONLY registry" in res.new[0].message
 
 
 # ------------------------------------------------------------------- R015

@@ -1868,28 +1868,73 @@ def _compiled_oracle(plan, corpus: bytes) -> bytes:
     return compile_plan(plan, CFG).run_corpus(corpus).output
 
 
+def _join_plan(combine="sum", deep=False):
+    """A join tree of wordcount fold leaves; deep=True chains a second
+    join on top (3 stages source->sink, the deep-pipeline shape)."""
+    from locust_tpu.plan.nodes import Plan, node
+
+    nodes = [
+        node("c1", "source", "text"),
+        node("m1", "map", "tokenize_count", ("c1",)),
+        node("s1", "shuffle", "by_key", ("m1",)),
+        node("r1", "reduce", "sum", ("s1",)),
+        node("c2", "source", "text"),
+        node("m2", "map", "tokenize_count", ("c2",)),
+        node("s2", "shuffle", "by_key", ("m2",)),
+        node("r2", "reduce", "sum", ("s2",)),
+        node("j1", "join", "inner", ("r1", "r2"), combine=combine),
+    ]
+    if deep:
+        nodes += [
+            node("c3", "source", "text"),
+            node("m3", "map", "tokenize_count", ("c3",)),
+            node("s3", "shuffle", "by_key", ("m3",)),
+            node("r3", "reduce", "sum", ("s3",)),
+            node("j2", "join", "inner", ("j1", "r3"), combine="mul"),
+            node("out", "sink", "table", ("j2",)),
+        ]
+    else:
+        nodes.append(node("out", "sink", "table", ("j1",)))
+    return Plan(tuple(nodes))
+
+
 def test_distribute_plan_shape_recognizes_covered_spines():
-    """plan_shape answers the distributable spine for exactly the three
-    covered folds and None for everything else (None = the solo path,
-    byte-identical by refusal — never an error)."""
+    """plan_shape answers (shape, reason): a StageShape / JoinShape /
+    IterateShape for every covered plan, and (None, reason) naming WHY
+    for everything else (None = the solo path, byte-identical by
+    refusal — never an error, never silent)."""
     from locust_tpu.plan import (
         index_plan,
         pagerank_plan,
         tfidf_plan,
         wordcount_plan,
     )
-    from locust_tpu.plan.distribute import plan_shape
+    from locust_tpu.plan.distribute import (
+        IterateShape,
+        JoinShape,
+        plan_shape,
+    )
     from locust_tpu.plan.nodes import Plan, node
 
-    wc = plan_shape(wordcount_plan())
+    wc, reason = plan_shape(wordcount_plan())
+    assert reason is None and wc.node_fp
     assert (wc.fold, wc.score, wc.sink_op) == ("wordcount", False, "table")
-    tf = plan_shape(tfidf_plan(2))
+    tf, _ = plan_shape(tfidf_plan(2))
     assert (tf.fold, tf.lines_per_doc, tf.score, tf.sink_op) == \
         ("tf", 2, True, "tfidf")
-    ix = plan_shape(index_plan(3))
+    ix, _ = plan_shape(index_plan(3))
     assert (ix.fold, ix.lines_per_doc, ix.sink_op) == ("index", 3, "postings")
-    assert plan_shape(pagerank_plan(3)) is None  # iterate: solo only
-    # A joined DAG is valid but not a covered spine: refusal, not error.
+    pr, reason = plan_shape(pagerank_plan(3, damping=0.9))
+    assert reason is None and isinstance(pr, IterateShape)
+    assert (pr.num_iters, pr.damping, pr.sink_op) == (3, 0.9, "ranks")
+    jn, reason = plan_shape(_join_plan("min"))
+    assert reason is None and isinstance(jn, JoinShape)
+    assert (jn.depth, jn.sink_op, jn.tree.combine) == (1, "table", "min")
+    assert len(jn.leaves) == 2  # distinct spines = distinct leaves
+    deep, _ = plan_shape(_join_plan(deep=True))
+    assert deep.depth == 2 and len(deep.leaves) == 3
+    # A named-input join is valid (run() with a data dict) but not a
+    # covered shape: structured refusal naming the reason, not an error.
     wide = Plan((
         node("c1", "source", "text"),
         node("m1", "map", "tokenize_count", ("c1",)),
@@ -1902,7 +1947,8 @@ def test_distribute_plan_shape_recognizes_covered_spines():
         node("j", "join", "inner", ("r1", "r2")),
         node("out", "sink", "table", ("j",)),
     ))
-    assert plan_shape(wide) is None
+    sh, reason = plan_shape(wide)
+    assert sh is None and reason == "source_named_input"
 
 
 def test_distribute_partition_publish_read_roundtrip(tmp_path):
@@ -1976,23 +2022,15 @@ def test_pool_distributed_plan_byte_identical_every_covered_fold():
 
 
 def test_pool_distributed_plan_local_floor_cases():
-    """Every refusal lands on the solo local engine, never an error:
-    an uncovered shape (pagerank), a job under the shard floor, and a
-    pool with a single live worker (a distributed run needs >= 2)."""
-    from locust_tpu.plan import pagerank_plan, tfidf_plan
+    """Every refusal lands on the solo local engine, never an error —
+    and never silently: each demotion bumps the plan_solo_fallbacks
+    counter (once-per-reason logged on the daemon).  Cases: a job under
+    the shard floor, a pool with a single live worker (a distributed
+    run needs >= 2), and a join whose fold overflows the configured
+    table (the identity gate — distributed can't reproduce solo's
+    truncation order, so it must not try)."""
+    from locust_tpu.plan import tfidf_plan
 
-    daemon, ws, client = _pool_rig(shard_min_blocks=1)
-    try:
-        edges = b"0 1\n1 2\n2 0\n" * 4
-        plan = pagerank_plan(3)
-        ack = client.submit(corpus=edges, config=CFG_OVR,
-                            plan=plan.to_doc(), no_cache=True)
-        res = client.wait(ack["job_id"], timeout=120.0)
-        assert res["pairs"][0][0] == _compiled_oracle(plan, edges)
-        assert client.status(ack["job_id"])["placed_on"] == "local"
-    finally:
-        _stop_workers(ws)
-        daemon.close()
     # Under the shard floor: a 2-block corpus with shard_min_blocks=8.
     daemon, ws, client = _pool_rig(shard_min_blocks=8)
     try:
@@ -2006,7 +2044,7 @@ def test_pool_distributed_plan_local_floor_cases():
         _stop_workers(ws)
         daemon.close()
     # One worker: the coordinator can't place two stages, releases the
-    # slot and takes the solo floor mid-dispatch.
+    # slot and takes the solo floor mid-dispatch — counted, not silent.
     daemon, ws, client = _pool_rig(n_workers=1, shard_min_blocks=1)
     try:
         ack = client.submit(corpus=CORPUS_A, config=CFG_OVR,
@@ -2015,6 +2053,29 @@ def test_pool_distributed_plan_local_floor_cases():
         assert res["pairs"][0][0] == _compiled_oracle(tfidf_plan(2),
                                                       CORPUS_A)
         assert client.status(ack["job_id"])["placed_on"] == "local"
+        assert client.stats()["pool"]["plan"]["plan_solo_fallbacks"] >= 1
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+    # Join capacity gate: a table too small for the joined vocabulary
+    # demotes to solo (which applies its own truncation discipline) and
+    # still answers byte-identically to the solo compiled plan.
+    from locust_tpu.config import EngineConfig
+
+    tiny = dict(CFG_OVR, table_size=8)
+    tiny_cfg = EngineConfig(**tiny)
+    daemon, ws, client = _pool_rig(shard_min_blocks=1)
+    corpus = CORPUS_A + CORPUS_B  # 10 distinct words > 8 slots
+    try:
+        plan = _join_plan("sum")
+        ack = client.submit(corpus=corpus, config=tiny,
+                            plan=plan.to_doc(), no_cache=True)
+        res = client.wait(ack["job_id"], timeout=120.0)
+        from locust_tpu.plan.compile import compile_plan
+        want = compile_plan(plan, tiny_cfg).run_corpus(corpus).output
+        assert res["pairs"][0][0] == want
+        assert client.status(ack["job_id"])["placed_on"] == "local"
+        assert client.stats()["pool"]["plan"]["plan_solo_fallbacks"] >= 1
     finally:
         _stop_workers(ws)
         daemon.close()
@@ -2099,6 +2160,181 @@ def test_pool_distributed_plan_wal_replay_resumes_from_stage_records(
             st = c2.status(ack["job_id"])
             assert st["placed_on"].startswith("plan:")
             assert c2.stats()["pool"]["plan"]["partitions_reused"] >= 2
+        finally:
+            d2.close()
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
+def test_pool_distributed_join_iterate_deep_byte_identical():
+    """Plan surface v2 identity pins: a join tree (both combines), a
+    3-stage deep pipeline, and an iterate (pagerank) all run DISTRIBUTED
+    across the 2-worker pool and answer byte-for-byte what the solo
+    compiled plan renders.  A warm repeat then lands every map stage on
+    the workers' cached fold-node executables: compiles stay flat and
+    map_warm_hits counts the skips — the perf contract, test-pinned."""
+    from locust_tpu.plan import pagerank_plan
+
+    daemon, ws, client = _pool_rig(shard_min_blocks=1)
+    corpus = CORPUS_A + CORPUS_B
+    edges = b"0 1\n1 2\n2 0\n0 2\n3 1\n2 3\n" * 3
+    cases = [
+        (_join_plan("sum"), corpus),
+        (_join_plan("min"), corpus),
+        (_join_plan(deep=True), corpus),
+        (pagerank_plan(4), edges),
+    ]
+    try:
+        for plan, cdata in cases:
+            ack = client.submit(corpus=cdata, config=CFG_OVR,
+                                plan=plan.to_doc(), no_cache=True)
+            res = client.wait(ack["job_id"], timeout=120.0)
+            assert res["plan"] is True
+            assert res["pairs"][0][0] == _compiled_oracle(plan, cdata)
+            st = client.status(ack["job_id"])
+            assert st["placed_on"].startswith("plan:")
+        # Warm repeat: resubmitting the join must hit the workers' warm
+        # fold-node executables — zero new compiles, counted hits.
+        pre = [w._serve_cache.stats()["compiles"] for w in ws]
+        plan, cdata = cases[0]
+        ack = client.submit(corpus=cdata, config=CFG_OVR,
+                            plan=plan.to_doc(), no_cache=True)
+        res = client.wait(ack["job_id"], timeout=120.0)
+        assert res["pairs"][0][0] == _compiled_oracle(plan, cdata)
+        post = [w._serve_cache.stats()["compiles"] for w in ws]
+        assert post == pre, f"warm repeat recompiled: {pre} -> {post}"
+        pl = client.stats()["pool"]["plan"]
+        assert pl["map_warm_hits"] > 0
+        assert pl["plan_solo_fallbacks"] == 0
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
+def test_pool_distributed_plan_random_dag_property():
+    """Seeded property test: randomly generated distributed-eligible
+    plans (fold spines, join trees one and two levels deep with random
+    combines, pagerank with random iteration counts and damping) are
+    byte-identical to the solo compiled plan under the 2-worker pool —
+    and stay byte-identical when one worker dies mid-stage (a chaos
+    crash on the shape's own stage phase; the survivor recomputes)."""
+    import random
+
+    from locust_tpu.plan import (
+        index_plan,
+        pagerank_plan,
+        tfidf_plan,
+        wordcount_plan,
+    )
+    from locust_tpu.utils import faultplan
+
+    rng = random.Random(0x20)
+    corpus = CORPUS_A + CORPUS_B
+    edges = b"0 1\n1 2\n2 0\n0 2\n3 1\n2 3\n" * 3
+
+    def rand_fold():
+        k = rng.choice(("wc", "tf", "ix"))
+        if k == "wc":
+            return wordcount_plan(), corpus, "map"
+        if k == "tf":
+            return tfidf_plan(rng.randint(1, 3)), corpus, "map"
+        return index_plan(rng.randint(1, 3)), corpus, "reduce"
+
+    def rand_join():
+        deep = rng.random() < 0.5
+        return (_join_plan(rng.choice(("sum", "mul", "min")), deep=deep),
+                corpus, "join")
+
+    def rand_iterate():
+        return (pagerank_plan(rng.randint(1, 4),
+                              damping=rng.choice((0.85, 0.9, 0.6))),
+                edges, "iterate")
+
+    shapes = [rand_fold(), rand_join(), rand_join(), rand_iterate(),
+              rand_iterate(), rand_fold()]
+    daemon, ws, client = _pool_rig(shard_min_blocks=1)
+    try:
+        for i, (plan, cdata, phase) in enumerate(shapes):
+            # One injected mid-stage death per shape, on its own phase.
+            p = faultplan.FaultPlan(
+                [{"site": "plan.stage", "action": "crash", "times": 1,
+                  "match": {"phase": phase}}], seed=i,
+            )
+            with faultplan.active_plan(p):
+                ack = client.submit(corpus=cdata, config=CFG_OVR,
+                                    plan=plan.to_doc(), no_cache=True)
+                res = client.wait(ack["job_id"], timeout=120.0)
+            assert res["pairs"][0][0] == _compiled_oracle(plan, cdata), \
+                f"shape {i} ({phase}) diverged from solo"
+            st = client.status(ack["job_id"])
+            assert st["placed_on"].startswith("plan:"), (i, st["placed_on"])
+        pl = client.stats()["pool"]["plan"]
+        assert pl["recomputes"] >= len(shapes)  # every crash was repaired
+        assert pl["plan_solo_fallbacks"] == 0
+    finally:
+        _stop_workers(ws)
+        daemon.close()
+
+
+def test_pool_distributed_iterate_wal_replay_resumes_from_epoch(tmp_path):
+    """Machine-death durability for iterate: the daemon is abandoned
+    after epoch 1 journaled its rank-shard records but while epoch 2 is
+    stalled in flight.  The restarted daemon's replay seeds the sweep
+    from the surviving epoch-1 partitions (partitions_reused counts
+    them) and finishes byte-identical to the solo compiled plan."""
+    from locust_tpu.plan import pagerank_plan
+    from locust_tpu.utils import faultplan
+
+    jd = str(tmp_path / "journal")
+    mk = dict(max_queue=16, max_batch=4, dispatch_poll_s=0.02,
+              retry_base_s=0.02, journal_dir=jd, shard_min_blocks=1)
+    from locust_tpu.distributor.worker import Worker
+
+    ws = []
+    for _ in range(2):
+        w = Worker(secret=SECRET, serve=True)
+        w.serve_in_thread()
+        ws.append(w)
+    addrs = tuple(f"127.0.0.1:{w.addr[1]}" for w in ws)
+    daemon = ServeDaemon(secret=SECRET,
+                         cfg=ServeConfig(workers=addrs, **mk))
+    daemon.serve_in_thread()
+    client = ServeClient(daemon.addr, SECRET, timeout=60.0)
+    edges = b"0 1\n1 2\n2 0\n0 2\n3 1\n2 3\n" * 3
+    plan = pagerank_plan(3)
+    # Stall every epoch-2 sweep RPC: epoch 1 lands (WAL epoch record +
+    # rank shards durable), epoch 2 never does — the abandon models the
+    # machine dying mid-iteration.
+    p = faultplan.FaultPlan(
+        [{"site": "plan.stage", "action": "delay", "delay_s": 60.0,
+          "match": {"phase": "iterate", "split": 2}, "times": 16}],
+        seed=13,
+    )
+    try:
+        with faultplan.active_plan(p):
+            ack = client.submit(corpus=edges, config=CFG_OVR,
+                                plan=plan.to_doc(), no_cache=True)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with open(daemon.journal.path, "rb") as f:
+                    if f.read().count(b'"rec":"stage"') >= 1:
+                        break
+                time.sleep(0.05)
+            else:
+                pytest.fail("epoch 1 never journaled its stage record")
+            serve_abandon(daemon)
+        d2 = ServeDaemon(secret=SECRET,
+                         cfg=ServeConfig(workers=addrs, **mk))
+        d2.serve_in_thread()
+        c2 = ServeClient(d2.addr, SECRET, timeout=60.0)
+        try:
+            res = c2.wait(ack["job_id"], timeout=120.0)
+            assert res["plan"] is True
+            assert res["pairs"][0][0] == _compiled_oracle(plan, edges)
+            st = c2.status(ack["job_id"])
+            assert st["placed_on"].startswith("plan:")
+            assert c2.stats()["pool"]["plan"]["partitions_reused"] >= 1
         finally:
             d2.close()
     finally:
